@@ -1,0 +1,25 @@
+"""shadow_tpu: a TPU-native conservative-window parallel discrete-event
+network simulator with the capabilities of the Shadow simulator.
+
+Where the reference (mckerrigan/shadow, see /root/repo/SURVEY.md) advances
+per-host mutexed priority queues with pthread worker pools, shadow_tpu keeps
+the entire simulation state — per-host event queues, TCP connection tables,
+NIC token buckets, CoDel router queues, topology latency matrices — as
+struct-of-arrays pytrees sharded over a `jax.sharding.Mesh`, advanced by
+vmapped kernels under `jit`, with the conservative time window implemented
+as a `lax.pmin` collective across the mesh.
+
+Simulation time is int64 nanoseconds (reference:
+src/main/core/support/definitions.h:18), which requires jax x64 mode; we
+enable it at import so every downstream module sees consistent dtypes.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from shadow_tpu.core import timebase  # noqa: E402,F401
+from shadow_tpu.core.events import Events, EventQueue  # noqa: E402,F401
+from shadow_tpu.core.engine import Engine, EngineConfig  # noqa: E402,F401

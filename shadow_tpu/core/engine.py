@@ -1,0 +1,362 @@
+"""The conservative-window simulation engine.
+
+Reference semantics being reproduced (see SURVEY.md §3.1-3.3):
+
+- Master computes conservative execution windows from the minimum
+  cross-host latency and drives rounds (reference:
+  src/main/core/master.c:133-159,450-480).
+- Workers pop events below the window barrier per host and execute them
+  (reference: src/main/core/worker.c:149-216,
+  scheduler_policy_host_single.c:210-271).
+- Cross-host sends roll reliability, add path latency, and are clamped up
+  to the window barrier to preserve causality (reference:
+  src/main/core/worker.c:243-304, scheduler_policy_host_single.c:180-184).
+
+TPU-native re-expression: all hosts pop/execute/emit in lockstep as one
+vmapped kernel over [H]-leading state arrays; the inner drain loop is a
+`lax.while_loop`; the window barrier is a global min over per-host
+next-event times (`lax.pmin` across the device mesh when sharded). One
+"round" of the reference's pthread barrier dance is one iteration of the
+outer while loop here — no locks, no threads, no barrier waits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core import rng as srng
+from shadow_tpu.core.events import N_ARGS, EventQueue, Events, queue_pop, queue_push
+from shadow_tpu.core.timebase import TIME_INVALID
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Emit:
+    """Up to K events emitted by one handler invocation (per host).
+
+    dst is a *global* host id; dt is a non-negative delay relative to the
+    executing event's time. local=True means a same-host scheduled task
+    (worker_scheduleTask semantics: dst is forced to self, no routing);
+    local=False means a network send that the engine routes — + path
+    latency, reliability drop roll, barrier clamp (worker_sendPacket
+    semantics) — including sends addressed to the sending host itself,
+    which traverse the topology's self-loop exactly like the reference.
+    """
+
+    dst: jax.Array  # i32[K]
+    dt: jax.Array  # i64[K]
+    kind: jax.Array  # i32[K]
+    args: jax.Array  # i32[K, N_ARGS]
+    mask: jax.Array  # bool[K]
+    local: jax.Array  # bool[K]
+
+    @staticmethod
+    def none(k: int, n_args: int = N_ARGS) -> "Emit":
+        return Emit(
+            dst=jnp.zeros((k,), jnp.int32),
+            dt=jnp.zeros((k,), jnp.int64),
+            kind=jnp.zeros((k,), jnp.int32),
+            args=jnp.zeros((k, n_args), jnp.int32),
+            mask=jnp.zeros((k,), bool),
+            local=jnp.zeros((k,), bool),
+        )
+
+    @staticmethod
+    def single(
+        dst, dt, kind, args=None, mask=True, local=False, n_args: int = N_ARGS
+    ) -> "Emit":
+        a = jnp.zeros((1, n_args), jnp.int32)
+        if args is not None:
+            args = jnp.asarray(args, jnp.int32).reshape(1, -1)
+            a = a.at[:, : args.shape[1]].set(args)
+        return Emit(
+            dst=jnp.asarray(dst, jnp.int32).reshape(1),
+            dt=jnp.asarray(dt, jnp.int64).reshape(1),
+            kind=jnp.asarray(kind, jnp.int32).reshape(1),
+            args=a,
+            mask=jnp.asarray(mask, bool).reshape(1),
+            local=jnp.asarray(local, bool).reshape(1),
+        )
+
+    def pad_to(self, k: int) -> "Emit":
+        cur = self.dst.shape[0]
+        if cur == k:
+            return self
+        assert cur < k, f"handler emitted {cur} > max_emit {k}"
+        return jax.tree.map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros((k - cur,) + a.shape[1:], a.dtype)]
+            ),
+            self,
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Stats:
+    """Per-host accounting (the reference's ObjectCounter/Tracker spirit)."""
+
+    n_executed: jax.Array  # i64[H]
+    n_emitted: jax.Array  # i64[H]
+    n_net_dropped: jax.Array  # i64[H] packets lost to reliability rolls
+    n_windows: jax.Array  # i64[] (replicated across shards)
+
+    @staticmethod
+    def create(n_hosts: int) -> "Stats":
+        z = jnp.zeros((n_hosts,), jnp.int64)
+        return Stats(z, z, z, jnp.zeros((), jnp.int64))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EngineState:
+    """Complete simulation state for one shard: a pure pytree.
+
+    Because state is a pytree of arrays, checkpoint/resume is trivial
+    (serialize the pytree) — a capability the reference lacks entirely
+    (SURVEY.md §5 "Checkpoint / resume: Absent").
+    """
+
+    now: jax.Array  # i64[] current window start (replicated)
+    queues: EventQueue
+    hosts: Any  # user pytree, every leaf [H, ...]
+    src_seq: jax.Array  # i32[H] per-source sequence counters
+    exec_cnt: jax.Array  # i32[H] per-host executed-event counters (RNG)
+    stats: Stats
+
+
+# Handler signature: (host_state_slice, ev: Events scalar, key) ->
+#                    (host_state_slice', Emit)
+Handler = Callable[[Any, Events, jax.Array], tuple[Any, Emit]]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    n_hosts: int  # hosts on this shard
+    capacity: int  # event queue slots per host
+    lookahead: int  # conservative window width, ns (min cross-host latency)
+    max_emit: int = 2  # K: max events emitted per handler invocation
+    n_args: int = N_ARGS
+    seed: int = 0
+    axis_name: str | None = None  # mesh axis hosts are sharded over
+
+
+def _select_rows(mask: jax.Array, new: Any, old: Any) -> Any:
+    """Per-host select across two equal-structure pytrees ([H, ...] leaves)."""
+
+    def sel(n, o):
+        m = mask.reshape(mask.shape + (1,) * (n.ndim - 1))
+        return jnp.where(m, n, o)
+
+    return jax.tree.map(sel, new, old)
+
+
+class Engine:
+    """Builds jittable window-step / run functions over a handler table.
+
+    `network.route(src_gid, dst_gid) -> (latency_ns i64, reliability f32)`
+    supplies the topology model (element-wise over arrays).
+    """
+
+    def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network):
+        self.cfg = cfg
+        self.handlers = tuple(handlers)
+        self.network = network
+        self._base_key = srng.root_key(cfg.seed)
+
+    # -- collectives (identity when unsharded) ------------------------------
+    def _gmin(self, x):
+        if self.cfg.axis_name is not None:
+            return jax.lax.pmin(x, self.cfg.axis_name)
+        return x
+
+    def _gany(self, x: jax.Array) -> jax.Array:
+        if self.cfg.axis_name is not None:
+            return jax.lax.psum(x.astype(jnp.int32), self.cfg.axis_name) > 0
+        return x
+
+    def _exchange(self, ev: Events, mask: jax.Array):
+        """Make every shard see every emitted event (v1: all_gather ring).
+
+        Each shard then keeps only events addressed to its own host range
+        inside queue_push. TODO(perf): replace with ppermute/all_to_all so
+        traffic scales with cross-shard packets, not total packets.
+        """
+        if self.cfg.axis_name is None:
+            return ev, mask
+        ax = self.cfg.axis_name
+        g = lambda x: jax.lax.all_gather(x, ax, tiled=True)
+        return jax.tree.map(g, ev), g(mask)
+
+    # -- state construction -------------------------------------------------
+    def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
+        cfg = self.cfg
+        q = EventQueue.create(cfg.n_hosts, cfg.capacity, cfg.n_args)
+        q = queue_push(q, initial.flatten(), initial.time.reshape(-1) != TIME_INVALID, host0)
+        return EngineState(
+            now=jnp.zeros((), jnp.int64),
+            queues=q,
+            hosts=hosts,
+            src_seq=jnp.zeros((cfg.n_hosts,), jnp.int32),
+            exec_cnt=jnp.zeros((cfg.n_hosts,), jnp.int32),
+            stats=Stats.create(cfg.n_hosts),
+        )
+
+    # -- one pop/execute/route/push sweep over all hosts --------------------
+    def _sweep(self, carry, window_end: jax.Array, host0: jax.Array):
+        q, hosts, src_seq, exec_cnt, stats = carry
+        cfg = self.cfg
+        h, k = cfg.n_hosts, cfg.max_emit
+        gids = host0 + jnp.arange(h, dtype=jnp.int32)
+
+        q, ev, active = queue_pop(q, window_end, gids)
+
+        hkeys, rkeys = srng.event_keys(self._base_key, gids, exec_cnt)
+
+        def per_host(hs, e, key):
+            branches = tuple(
+                (lambda fn: lambda: _pad(fn(hs, e, key), k))(fn) for fn in self.handlers
+            )
+
+            def _pad(res, kk):
+                hs2, em = res
+                return hs2, em.pad_to(kk)
+
+            idx = jnp.clip(e.kind, 0, len(branches) - 1)
+            return jax.lax.switch(idx, branches)
+
+        hosts2, emit = jax.vmap(per_host)(hosts, ev, hkeys)
+        hosts = _select_rows(active, hosts2, hosts)
+        emask = emit.mask & active[:, None]
+
+        # per-source sequence numbers, dense over the masked emits so the
+        # numbering is independent of K padding (event.c:110-153 tie-break)
+        inc = emask.astype(jnp.int32)
+        within = jnp.cumsum(inc, axis=1) - inc
+        seq = src_seq[:, None] + within
+        src_seq = src_seq + jnp.sum(inc, axis=1, dtype=jnp.int32)
+
+        # route: local tasks keep their time; network sends add latency,
+        # roll reliability, and clamp to the window barrier (self-addressed
+        # sends traverse the topology self-loop like any other packet)
+        self_gid = gids[:, None]
+        is_local = emit.local
+        dst = jnp.where(is_local, self_gid, emit.dst)
+        dt = jnp.maximum(emit.dt, 0)
+        lat, rel = self.network.route(jnp.broadcast_to(self_gid, (h, k)), dst)
+        t = ev.time[:, None] + dt
+        t_remote = jnp.maximum(t + lat, window_end)
+        t = jnp.where(is_local, t, t_remote)
+
+        def roll(key, kidx):
+            return jax.random.uniform(jax.random.fold_in(key, kidx))
+
+        u = jax.vmap(
+            lambda key: jax.vmap(lambda i: roll(key, i))(jnp.arange(k, dtype=jnp.uint32))
+        )(rkeys)
+        dropped = (~is_local) & (u >= rel) & emask
+        final_mask = emask & ~dropped
+
+        out = Events(
+            time=jnp.where(final_mask, t, TIME_INVALID),
+            dst=dst,
+            src=jnp.broadcast_to(self_gid, (h, k)).astype(jnp.int32),
+            seq=seq,
+            kind=emit.kind,
+            args=emit.args,
+        )
+        out_flat, mask_flat = self._exchange(out.flatten(), final_mask.reshape(-1))
+        q = queue_push(q, out_flat, mask_flat, host0)
+
+        exec_cnt = exec_cnt + active.astype(jnp.int32)
+        stats = dataclasses.replace(
+            stats,
+            n_executed=stats.n_executed + active,
+            n_emitted=stats.n_emitted + jnp.sum(inc, axis=1, dtype=jnp.int64),
+            n_net_dropped=stats.n_net_dropped + jnp.sum(dropped, axis=1, dtype=jnp.int64),
+        )
+        return (q, hosts, src_seq, exec_cnt, stats)
+
+    # -- window = drain all events below the barrier ------------------------
+    def _drain_window(self, st: EngineState, window_end, host0):
+        def cond(carry):
+            q = carry[0]
+            return self._gany(jnp.any(q.min_time() < window_end))
+
+        def body(carry):
+            return self._sweep(carry, window_end, host0)
+
+        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats)
+        q, hosts, src_seq, exec_cnt, stats = jax.lax.while_loop(cond, body, carry)
+        return dataclasses.replace(
+            st,
+            queues=q,
+            hosts=hosts,
+            src_seq=src_seq,
+            exec_cnt=exec_cnt,
+            stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
+        )
+
+    def step_window(self, st: EngineState, stop, host0=0) -> EngineState:
+        """Advance one conservative window (jittable; no-op when finished)."""
+        host0 = jnp.asarray(host0, jnp.int32)
+        stop = jnp.asarray(stop, jnp.int64)
+        nxt = self._gmin(jnp.min(st.queues.min_time()))
+
+        def go(st):
+            window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
+            st = self._drain_window(st, window_end, host0)
+            return dataclasses.replace(st, now=window_end)
+
+        def done(st):
+            # no event below stop remains: land on stop so callers looping
+            # "while now < stop: step_window" terminate
+            return dataclasses.replace(st, now=stop)
+
+        return jax.lax.cond(nxt < stop, go, done, st)
+
+    def run(self, st: EngineState, stop, host0=0) -> EngineState:
+        """Run until no pending event is earlier than `stop` (jittable).
+
+        This is the whole of master_run/slave_run/worker_run collapsed into
+        one compiled loop: window barrier = global pmin, round = outer
+        iteration, event execution = vmapped sweeps.
+        """
+        host0 = jnp.asarray(host0, jnp.int32)
+        stop = jnp.asarray(stop, jnp.int64)
+
+        def cond(st):
+            nxt = self._gmin(jnp.min(st.queues.min_time()))
+            return nxt < stop
+
+        def body(st):
+            nxt = self._gmin(jnp.min(st.queues.min_time()))
+            window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
+            st = self._drain_window(st, window_end, host0)
+            return dataclasses.replace(st, now=window_end)
+
+        st = jax.lax.while_loop(cond, body, st)
+        return dataclasses.replace(st, now=stop)
+
+
+class ConstantNetwork:
+    """Uniform complete-graph network: fixed latency, fixed reliability.
+
+    Mirrors the single-PoI topologies the reference's tests embed (e.g.
+    src/test/phold/phold.test.shadow.config.xml: one vertex, 50ms self-loop).
+    """
+
+    def __init__(self, latency_ns: int, reliability: float = 1.0):
+        self.latency_ns = latency_ns
+        self.reliability = reliability
+
+    def route(self, src, dst):
+        shape = jnp.broadcast_shapes(src.shape, dst.shape)
+        return (
+            jnp.full(shape, self.latency_ns, jnp.int64),
+            jnp.full(shape, self.reliability, jnp.float32),
+        )

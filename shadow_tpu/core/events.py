@@ -1,0 +1,233 @@
+"""Event records and bounded per-host event queues, struct-of-arrays.
+
+The reference keeps one locked binary-heap priority queue per virtual host
+(reference: src/main/core/scheduler/scheduler_policy_host_single.c:20-25,
+src/main/utility/priority_queue.c) and defines a deterministic total order
+over events as the tuple (time, dstHostID, srcHostID, per-src sequence)
+(reference: src/main/core/work/event.c:110-153).
+
+Here every host's queue is a fixed-capacity slot array; all hosts' queues
+form [H, C] device arrays. Pop-min is a masked reduction per row (so it
+vectorizes over all hosts at once on the VPU); push is a sort-based batch
+scatter that assigns each incoming event a distinct free slot, so the
+scatter is collision-free and therefore deterministic. Slot order carries
+no meaning — ordering lives entirely in the (time, src, seq) key — so the
+queue needs no heap maintenance at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core.timebase import TIME_INVALID
+
+# Number of i32 payload words carried by every event. The reference carries a
+# Task closure pointer + argument pointers (src/main/core/work/task.c:13-41);
+# we carry a fixed tuple of words whose meaning depends on `kind`.
+N_ARGS = 6
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Events:
+    """A batch of event records (any leading shape).
+
+    time: i64[...]  absolute sim time (TIME_INVALID = empty)
+    dst:  i32[...]  destination (global) host id
+    src:  i32[...]  source (global) host id
+    seq:  i32[...]  per-source sequence number (tie-break)
+    kind: i32[...]  handler index
+    args: i32[..., N_ARGS] payload words
+    """
+
+    time: jax.Array
+    dst: jax.Array
+    src: jax.Array
+    seq: jax.Array
+    kind: jax.Array
+    args: jax.Array
+
+    @staticmethod
+    def empty(shape, n_args: int = N_ARGS) -> "Events":
+        shape = tuple(shape) if not isinstance(shape, int) else (shape,)
+        i32 = jnp.int32
+        return Events(
+            time=jnp.full(shape, TIME_INVALID, jnp.int64),
+            dst=jnp.zeros(shape, i32),
+            src=jnp.zeros(shape, i32),
+            seq=jnp.zeros(shape, i32),
+            kind=jnp.zeros(shape, i32),
+            args=jnp.zeros(shape + (n_args,), i32),
+        )
+
+    @property
+    def shape(self):
+        return self.time.shape
+
+    def flatten(self) -> "Events":
+        """Collapse the batch dims shared by all fields into one.
+
+        args keeps its trailing N_ARGS dim; every other field is fully flat.
+        """
+        nb = self.time.ndim
+        return jax.tree.map(
+            lambda a: a.reshape((-1,) + a.shape[nb:]), self
+        )
+
+    def at(self, idx) -> "Events":
+        return jax.tree.map(lambda a: a[idx], self)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EventQueue:
+    """All hosts' bounded event queues on one shard: [H, C] slot arrays.
+
+    A slot is empty iff time == TIME_INVALID. `drops` counts events lost to
+    queue overflow per host (the reference's queues are unbounded; we bound
+    and account, in the spirit of its ObjectCounter leak accounting —
+    reference: src/main/core/support/object_counter.c).
+    """
+
+    time: jax.Array  # i64[H, C]
+    src: jax.Array  # i32[H, C]
+    seq: jax.Array  # i32[H, C]
+    kind: jax.Array  # i32[H, C]
+    args: jax.Array  # i32[H, C, N_ARGS]
+    drops: jax.Array  # i32[H]
+
+    @staticmethod
+    def create(n_hosts: int, capacity: int, n_args: int = N_ARGS) -> "EventQueue":
+        i32 = jnp.int32
+        return EventQueue(
+            time=jnp.full((n_hosts, capacity), TIME_INVALID, jnp.int64),
+            src=jnp.zeros((n_hosts, capacity), i32),
+            seq=jnp.zeros((n_hosts, capacity), i32),
+            kind=jnp.zeros((n_hosts, capacity), i32),
+            args=jnp.zeros((n_hosts, capacity, n_args), i32),
+            drops=jnp.zeros((n_hosts,), i32),
+        )
+
+    @property
+    def n_hosts(self) -> int:
+        return self.time.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[1]
+
+    def valid(self) -> jax.Array:
+        return self.time != TIME_INVALID
+
+    def size(self) -> jax.Array:
+        return jnp.sum(self.valid(), axis=1, dtype=jnp.int32)
+
+    def min_time(self) -> jax.Array:
+        """Earliest pending event time per host (TIME_INVALID if empty)."""
+        return jnp.min(self.time, axis=1)
+
+
+def _tiebreak_key(src: jax.Array, seq: jax.Array) -> jax.Array:
+    """Pack (src, seq) into one i64 so a single argmin resolves ties.
+
+    Within one host's queue, dst is constant, so the reference's total order
+    (time, dst, src, seq) (event.c:110-153) reduces to (time, src, seq).
+    """
+    return (src.astype(jnp.int64) << 32) | seq.astype(jnp.uint32).astype(jnp.int64)
+
+
+def queue_pop(
+    q: EventQueue, before: jax.Array, host_ids: jax.Array
+) -> tuple[EventQueue, Events, jax.Array]:
+    """Pop, per host, the minimum-(time,src,seq) event with time < `before`.
+
+    Vectorized over all hosts: two masked row reductions (min time, then min
+    tie-break key among slots at that time) and one collision-free scatter to
+    clear the popped slots.
+
+    Returns (queue', events[H], active[H]) where active[h] says host h popped
+    a real event. Inactive rows contain garbage fields (time=TIME_INVALID).
+    """
+    h = q.n_hosts
+    t = q.time
+    min_t = jnp.min(t, axis=1)  # i64[H]
+    is_min = t == min_t[:, None]
+    key2 = jnp.where(is_min, _tiebreak_key(q.src, q.seq), jnp.iinfo(jnp.int64).max)
+    slot = jnp.argmin(key2, axis=1)  # i32[H]
+    active = min_t < before
+
+    rows = jnp.arange(h)
+    take = lambda a: a[rows, slot]
+    ev = Events(
+        time=jnp.where(active, take(q.time), TIME_INVALID),
+        dst=host_ids.astype(jnp.int32),
+        src=take(q.src),
+        seq=take(q.seq),
+        kind=take(q.kind),
+        args=q.args[rows, slot],
+    )
+    new_time = q.time.at[rows, slot].set(
+        jnp.where(active, TIME_INVALID, take(q.time))
+    )
+    return dataclasses.replace(q, time=new_time), ev, active
+
+
+def queue_push(
+    q: EventQueue, ev: Events, mask: jax.Array, host0
+) -> EventQueue:
+    """Insert a flat batch of events [M] into their destination queues.
+
+    `host0` is the global id of this shard's first host; events whose dst
+    falls outside [host0, host0 + H) are silently ignored (the caller routes
+    cross-shard events via collectives before pushing). Overflowing events
+    (destination queue full) are dropped and counted in `drops`, mirroring
+    where the reference would grow its unbounded heap.
+
+    Algorithm: sort events by local dst (stable), rank each event within its
+    dst run, list each queue's free slots in slot order (argsort of the
+    occupancy mask — False sorts first), and give the rank-th event the
+    rank-th free slot. Every surviving event gets a distinct (row, slot), so
+    the scatter has no collisions and the result is order-deterministic.
+    """
+    h, c = q.n_hosts, q.capacity
+    m = ev.time.shape[0]
+
+    local = ev.dst - jnp.asarray(host0, jnp.int32)
+    ok = mask & (local >= 0) & (local < h)
+    dkey = jnp.where(ok, local, h)  # out-of-shard / masked events sort last
+    order = jnp.argsort(dkey, stable=True)
+    sd = dkey[order]  # i32[M] sorted local dst
+
+    pos = jnp.arange(m, dtype=jnp.int32)
+    run_start = jnp.where(
+        jnp.concatenate([jnp.ones((1,), bool), sd[1:] != sd[:-1]]), pos, 0
+    )
+    run_start = jax.lax.associative_scan(jnp.maximum, run_start)
+    rank = pos - run_start  # position within the same-dst run
+
+    occupied = q.valid()
+    free_order = jnp.argsort(occupied, axis=1, stable=True)  # free slots first
+    free_cnt = c - jnp.sum(occupied, axis=1, dtype=jnp.int32)
+
+    row = jnp.minimum(sd, h - 1)
+    slot = free_order[row, jnp.minimum(rank, c - 1)]
+    live = (sd < h) & (rank < free_cnt[row])
+    over = (sd < h) & ~live
+
+    # mode="drop" discards writes for dead rows instead of writing garbage
+    # (a dead row sharing a clamped (row, slot) with a live one would race).
+    drow = jnp.where(live, row, h)
+    evo = ev.at(order)
+    new = dataclasses.replace(
+        q,
+        time=q.time.at[drow, slot].set(evo.time, mode="drop"),
+        src=q.src.at[drow, slot].set(evo.src, mode="drop"),
+        seq=q.seq.at[drow, slot].set(evo.seq, mode="drop"),
+        kind=q.kind.at[drow, slot].set(evo.kind, mode="drop"),
+        args=q.args.at[drow, slot].set(evo.args, mode="drop"),
+        drops=q.drops.at[jnp.where(over, row, h)].add(1, mode="drop"),
+    )
+    return new

@@ -1,0 +1,32 @@
+"""Deterministic per-host random streams.
+
+The reference derives determinism from a seed hierarchy master→slave→host of
+`rand_r` streams (reference: src/main/utility/random.c:15-50,
+src/main/core/master.c:95, src/main/host/host.c:176). Here we use JAX's
+counter-based threefry generator: every executed event gets a key derived
+from (root seed, global host id, per-host execution counter), which is
+bit-reproducible regardless of how hosts are sharded across chips.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def event_keys(base: jax.Array, host_gids: jax.Array, exec_cnt: jax.Array):
+    """Per-host (handler_key, route_key) for the current event execution.
+
+    handler_key is consumed by the application/protocol handler; route_key is
+    consumed by the engine for reliability drop rolls — split so the two can
+    never collide however many fold_ins a handler performs.
+    """
+
+    def one(gid, cnt):
+        k = jax.random.fold_in(jax.random.fold_in(base, gid), cnt)
+        hk, rk = jax.random.split(k)
+        return hk, rk
+
+    return jax.vmap(one)(host_gids.astype(jnp.uint32), exec_cnt.astype(jnp.uint32))

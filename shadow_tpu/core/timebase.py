@@ -1,0 +1,35 @@
+"""Simulation time base.
+
+SimTime is int64 nanoseconds since simulation start, mirroring the
+reference's `SimulationTime` u64-ns convention
+(reference: src/main/core/support/definitions.h:18-78). Emulated wall time
+presented to applications is offset to the Y2K epoch exactly like the
+reference's EMULATED_TIME_OFFSET.
+"""
+
+import jax.numpy as jnp
+
+TIME_DTYPE = jnp.int64
+
+NANOSECOND = 1
+MICROSECOND = 1_000
+MILLISECOND = 1_000_000
+SECOND = 1_000_000_000
+MINUTE = 60 * SECOND
+HOUR = 60 * MINUTE
+
+# Jan 1 2000 00:00 UTC in unix ns — the epoch applications observe
+# (reference: definitions.h:78 EMULATED_TIME_OFFSET).
+EMULATED_TIME_OFFSET = 946_684_800 * SECOND
+
+# Sentinel meaning "no event" / "empty slot"; sorts after every real time.
+TIME_INVALID = jnp.iinfo(jnp.int64).max
+
+# Maximum simulateable instant (one century, same spirit as the reference's
+# SIMTIME_MAX bound).
+TIME_MAX = 100 * 365 * 24 * HOUR
+
+
+def seconds(x: float) -> int:
+    """Convert float seconds to integer SimTime nanoseconds."""
+    return int(round(x * SECOND))

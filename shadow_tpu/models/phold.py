@@ -1,0 +1,99 @@
+"""PHOLD: the classic parallel-DES benchmark, as a jitted host behavior.
+
+The reference ships PHOLD as a plugin — N peers bounce UDP messages to
+weighted-random targets (reference: src/test/phold/test_phold.c:36-52, config
+src/test/phold/phold.test.shadow.config.xml). It is the natural first
+benchmark for the engine (SURVEY.md §4, §6): every executed event emits one
+new event to a random peer, so steady-state event population is constant and
+events/sec is measured directly.
+
+Here each host's behavior is a handler compiled into the device step: on
+receiving a message, pick a uniform random peer and send a new message with
+an exponential service delay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core.engine import Emit, Engine, EngineConfig, ConstantNetwork
+from shadow_tpu.core.events import Events
+from shadow_tpu.core.timebase import MILLISECOND, TIME_INVALID
+
+KIND_MSG = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PholdHost:
+    n_received: jax.Array  # i64[] per host
+
+    @staticmethod
+    def create(n_hosts: int) -> "PholdHost":
+        return PholdHost(n_received=jnp.zeros((n_hosts,), jnp.int64))
+
+
+def make_handler(n_hosts_global: int, mean_delay_ns: int):
+    def on_msg(hs: PholdHost, ev: Events, key: jax.Array):
+        kp, kd = jax.random.split(key)
+        peer = jax.random.randint(kp, (), 0, n_hosts_global, dtype=jnp.int32)
+        delay = (
+            jax.random.exponential(kd, dtype=jnp.float32) * mean_delay_ns
+        ).astype(jnp.int64)
+        hs = PholdHost(n_received=hs.n_received + 1)
+        return hs, Emit.single(dst=peer, dt=delay, kind=KIND_MSG)
+
+    return on_msg
+
+
+def build(
+    n_hosts: int,
+    *,
+    capacity: int = 64,
+    latency_ns: int = 50 * MILLISECOND,
+    mean_delay_ns: int = 10 * MILLISECOND,
+    msgs_per_host: int = 1,
+    seed: int = 0,
+    axis_name: str | None = None,
+    n_shards: int = 1,
+):
+    """Build (engine, initial_state) for an n_hosts PHOLD network.
+
+    The 50ms single-PoI topology matches the reference's stock config.
+    With axis_name set, n_hosts is the *per-shard* host count.
+    """
+    cfg = EngineConfig(
+        n_hosts=n_hosts,
+        capacity=capacity,
+        lookahead=latency_ns,
+        max_emit=1,
+        seed=seed,
+        axis_name=axis_name,
+    )
+    net = ConstantNetwork(latency_ns)
+    eng = Engine(cfg, [make_handler(n_hosts * n_shards, mean_delay_ns)], net)
+
+    def init(host0=0):
+        init_ev = Events.empty((n_hosts, msgs_per_host))
+        gids = host0 + jnp.arange(n_hosts, dtype=jnp.int32)
+        init_ev = dataclasses.replace(
+            init_ev,
+            # stagger start times so the first window isn't one giant burst
+            time=jnp.broadcast_to(
+                (gids[:, None].astype(jnp.int64) % 16 + 1) * MILLISECOND,
+                (n_hosts, msgs_per_host),
+            ),
+            dst=jnp.broadcast_to(gids[:, None], (n_hosts, msgs_per_host)),
+            src=jnp.broadcast_to(gids[:, None], (n_hosts, msgs_per_host)),
+            seq=jnp.broadcast_to(
+                jnp.arange(msgs_per_host, dtype=jnp.int32)[None, :],
+                (n_hosts, msgs_per_host),
+            ),
+            kind=jnp.full((n_hosts, msgs_per_host), KIND_MSG, jnp.int32),
+        )
+        return eng.init_state(PholdHost.create(n_hosts), init_ev, host0)
+
+    return eng, init

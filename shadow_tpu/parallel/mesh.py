@@ -1,0 +1,82 @@
+"""Host sharding across a TPU device mesh.
+
+The reference assigns hosts to worker pthreads by random shuffle
+(reference: src/main/core/scheduler/scheduler.c:440-534) and synchronizes
+rounds with 6 countdown-latch barriers (scheduler.c:124-129). Here hosts are
+block-partitioned across a 1-D `jax.sharding.Mesh` axis ("hosts" — the
+data-parallel axis of this framework); every engine state leaf is sharded on
+its leading host dimension; the round barrier is `lax.pmin` and cross-shard
+packet delivery rides XLA collectives over ICI (SURVEY.md §2.4
+"Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+HOSTS_AXIS = "hosts"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = HOSTS_AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, found {len(devs)} "
+                f"(set --xla_force_host_platform_device_count for CPU testing)"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def state_specs(st, n_hosts_local: int, axis: str = HOSTS_AXIS):
+    """PartitionSpec pytree for an EngineState: leaves with a leading
+    per-shard host dim shard on `axis`; scalars (now, n_windows) replicate."""
+
+    def spec(leaf):
+        if leaf.ndim >= 1 and leaf.shape[0] == n_hosts_local:
+            return P(axis)
+        return P()
+
+    return jax.tree.map(spec, st)
+
+
+def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int, axis: str = HOSTS_AXIS):
+    """Wrap an axis-aware Engine into sharded init/run/step callables.
+
+    `eng` must have been built with axis_name=axis and per-shard host count
+    n_hosts_local. Returns (init, run, step_window), all jitted over `mesh`:
+    init() -> sharded EngineState; run(st, stop) / step_window(st, stop).
+    """
+
+    def _host0():
+        return jax.lax.axis_index(axis).astype(jnp.int32) * n_hosts_local
+
+    template = jax.eval_shape(init_fn, jnp.zeros((), jnp.int32))
+    specs = state_specs(template, n_hosts_local, axis)
+
+    init = jax.jit(
+        jax.shard_map(
+            lambda: init_fn(_host0()),
+            mesh=mesh,
+            in_specs=(),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )
+
+    def _wrap(fn):
+        return jax.jit(
+            jax.shard_map(
+                lambda s, t: fn(s, t, _host0()),
+                mesh=mesh,
+                in_specs=(specs, P()),
+                out_specs=specs,
+                check_vma=False,
+            )
+        )
+
+    return init, _wrap(eng.run), _wrap(eng.step_window)

@@ -1,0 +1,21 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding correctness is tested
+on XLA's forced host-platform device count, exactly as the driver's
+dryrun_multichip does. The environment's sitecustomize registers a remote
+TPU backend and forces jax_platforms programmatically, so the env var alone
+is not enough — we must update jax.config before any backend initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

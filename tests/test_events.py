@@ -1,0 +1,103 @@
+"""Unit tests for the bounded per-host event queues (events.py).
+
+Mirrors the correctness properties of the reference's PriorityQueue +
+event total order (src/main/utility/priority_queue.c,
+src/main/core/work/event.c:110-153): pop yields (time, src, seq)-minimal
+events, pushes land in the right queues, overflow is accounted.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core.events import EventQueue, Events, queue_pop, queue_push
+from shadow_tpu.core.timebase import TIME_INVALID
+
+
+def mk_events(rows):
+    """rows: list of (time, dst, src, seq, kind)."""
+    n = len(rows)
+    t, d, s, q, k = (np.array([r[i] for r in rows]) for i in range(5))
+    return Events(
+        time=jnp.asarray(t, jnp.int64),
+        dst=jnp.asarray(d, jnp.int32),
+        src=jnp.asarray(s, jnp.int32),
+        seq=jnp.asarray(q, jnp.int32),
+        kind=jnp.asarray(k, jnp.int32),
+        args=jnp.zeros((n, 6), jnp.int32),
+    )
+
+
+def test_push_pop_roundtrip():
+    q = EventQueue.create(n_hosts=4, capacity=8)
+    ev = mk_events([(100, 2, 0, 0, 7), (50, 2, 1, 0, 8), (70, 0, 3, 0, 9)])
+    q = queue_push(q, ev, jnp.ones(3, bool), host0=0)
+    assert q.size().tolist() == [1, 0, 2, 0]
+
+    gids = jnp.arange(4, dtype=jnp.int32)
+    q, out, active = queue_pop(q, jnp.int64(10_000), gids)
+    assert active.tolist() == [True, False, True, False]
+    # host 2 must pop its (time,src,seq)-minimal event: time 50 from src 1
+    assert int(out.time[2]) == 50 and int(out.src[2]) == 1 and int(out.kind[2]) == 8
+    assert int(out.time[0]) == 70 and int(out.kind[0]) == 9
+    assert q.size().tolist() == [0, 0, 1, 0]
+
+
+def test_pop_respects_window_barrier():
+    q = EventQueue.create(2, 4)
+    q = queue_push(q, mk_events([(500, 0, 0, 0, 1), (10, 1, 0, 0, 2)]), jnp.ones(2, bool), 0)
+    gids = jnp.arange(2, dtype=jnp.int32)
+    q, out, active = queue_pop(q, jnp.int64(100), gids)
+    assert active.tolist() == [False, True]
+    assert q.size().tolist() == [1, 0]
+
+
+def test_tie_break_src_then_seq():
+    # same time: lower src wins; same src: lower seq wins
+    q = EventQueue.create(1, 8)
+    ev = mk_events([(5, 0, 9, 0, 0), (5, 0, 3, 7, 1), (5, 0, 3, 2, 2)])
+    q = queue_push(q, ev, jnp.ones(3, bool), 0)
+    gids = jnp.zeros((1,), jnp.int32)
+    order = []
+    for _ in range(3):
+        q, out, active = queue_pop(q, jnp.int64(10), gids)
+        assert bool(active[0])
+        order.append((int(out.src[0]), int(out.seq[0])))
+    assert order == [(3, 2), (3, 7), (9, 0)]
+
+
+def test_multi_push_same_dst_and_overflow():
+    q = EventQueue.create(2, capacity=3)
+    rows = [(i + 1, 0, 0, i, 0) for i in range(5)] + [(9, 1, 0, 0, 0)]
+    q = queue_push(q, mk_events(rows), jnp.ones(6, bool), 0)
+    assert q.size().tolist() == [3, 1]
+    assert q.drops.tolist() == [2, 0]
+    # surviving events for host 0 are a subset; pop yields increasing times
+    gids = jnp.arange(2, dtype=jnp.int32)
+    times = []
+    for _ in range(3):
+        q, out, active = queue_pop(q, jnp.int64(100), gids)
+        times.append(int(out.time[0]))
+    assert times == sorted(times)
+
+
+def test_out_of_shard_events_ignored():
+    q = EventQueue.create(2, 4)
+    ev = mk_events([(1, 5, 0, 0, 0), (2, 3, 0, 0, 0), (3, 2, 0, 0, 0)])
+    q = queue_push(q, ev, jnp.ones(3, bool), host0=2)  # shard owns gids [2, 4)
+    assert q.size().tolist() == [1, 1]  # gid 2 -> row 0, gid 3 -> row 1; gid 5 dropped
+    assert q.drops.tolist() == [0, 0]  # out-of-shard is not an overflow drop
+
+
+def test_masked_push_ignored():
+    q = EventQueue.create(1, 4)
+    ev = mk_events([(1, 0, 0, 0, 0), (2, 0, 0, 0, 0)])
+    q = queue_push(q, ev, jnp.asarray([True, False]), 0)
+    assert int(q.size()[0]) == 1
+
+
+def test_empty_queue_pop_inactive():
+    q = EventQueue.create(3, 4)
+    gids = jnp.arange(3, dtype=jnp.int32)
+    q, out, active = queue_pop(q, jnp.int64(10**15), gids)
+    assert not bool(active.any())
+    assert (out.time == TIME_INVALID).all()
