@@ -144,6 +144,14 @@ class EngineConfig:
     seed: int = 0
     axis_name: str | None = None  # mesh axis hosts are sharded over
 
+    def __post_init__(self):
+        # a window of width 0 can never drain an event: the compiled outer
+        # loop would spin forever on-device with no Python escape. The
+        # reference bounds runahead below by 1ms for the same reason
+        # (master.c:133-159 minTimeJump floor).
+        if self.lookahead < 1:
+            raise ValueError(f"lookahead must be >= 1 ns, got {self.lookahead}")
+
 
 def _select_rows(mask: jax.Array, new: Any, old: Any) -> Any:
     """Per-host select across two equal-structure pytrees ([H, ...] leaves)."""
@@ -196,12 +204,23 @@ class Engine:
     def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
         cfg = self.cfg
         q = EventQueue.create(cfg.n_hosts, cfg.capacity, cfg.n_args)
-        q = queue_push(q, initial.flatten(), initial.time.reshape(-1) != TIME_INVALID, host0)
+        flat = initial.flatten()
+        valid = flat.time != TIME_INVALID
+        q = queue_push(q, flat, valid, host0)
+        # start each source's sequence counter past any seq the initial
+        # events consumed, so engine-emitted events never reuse a (src, seq)
+        # pair — uniqueness is what makes the (time, src, seq) total order
+        # deterministic (event.c:110-153)
+        local_src = flat.src - jnp.asarray(host0, jnp.int32)
+        seq0 = jnp.zeros((cfg.n_hosts,), jnp.int32).at[
+            jnp.where(valid & (local_src >= 0) & (local_src < cfg.n_hosts),
+                      local_src, cfg.n_hosts)
+        ].max(flat.seq + 1, mode="drop")
         return EngineState(
             now=jnp.zeros((), jnp.int64),
             queues=q,
             hosts=hosts,
-            src_seq=jnp.zeros((cfg.n_hosts,), jnp.int32),
+            src_seq=seq0,
             exec_cnt=jnp.zeros((cfg.n_hosts,), jnp.int32),
             stats=Stats.create(cfg.n_hosts),
         )
@@ -301,45 +320,53 @@ class Engine:
             stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
         )
 
+    def _next_time(self, st: EngineState) -> jax.Array:
+        """Global earliest pending event time (one reduction + one pmin)."""
+        return self._gmin(jnp.min(st.queues.min_time()))
+
+    def _advance(self, st: EngineState, nxt, stop, host0) -> EngineState:
+        """Open the window [nxt, min(nxt+lookahead, stop)) and drain it."""
+        window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
+        st = self._drain_window(st, window_end, host0)
+        return dataclasses.replace(st, now=window_end)
+
     def step_window(self, st: EngineState, stop, host0=0) -> EngineState:
         """Advance one conservative window (jittable; no-op when finished)."""
         host0 = jnp.asarray(host0, jnp.int32)
         stop = jnp.asarray(stop, jnp.int64)
-        nxt = self._gmin(jnp.min(st.queues.min_time()))
-
-        def go(st):
-            window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
-            st = self._drain_window(st, window_end, host0)
-            return dataclasses.replace(st, now=window_end)
+        nxt = self._next_time(st)
 
         def done(st):
             # no event below stop remains: land on stop so callers looping
             # "while now < stop: step_window" terminate
             return dataclasses.replace(st, now=stop)
 
-        return jax.lax.cond(nxt < stop, go, done, st)
+        return jax.lax.cond(
+            nxt < stop, lambda s: self._advance(s, nxt, stop, host0), done, st
+        )
 
     def run(self, st: EngineState, stop, host0=0) -> EngineState:
         """Run until no pending event is earlier than `stop` (jittable).
 
         This is the whole of master_run/slave_run/worker_run collapsed into
         one compiled loop: window barrier = global pmin, round = outer
-        iteration, event execution = vmapped sweeps.
+        iteration, event execution = vmapped sweeps. The next-event time is
+        threaded through the carry so each window costs exactly one global
+        reduction + pmin collective.
         """
         host0 = jnp.asarray(host0, jnp.int32)
         stop = jnp.asarray(stop, jnp.int64)
 
-        def cond(st):
-            nxt = self._gmin(jnp.min(st.queues.min_time()))
+        def cond(carry):
+            _, nxt = carry
             return nxt < stop
 
-        def body(st):
-            nxt = self._gmin(jnp.min(st.queues.min_time()))
-            window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
-            st = self._drain_window(st, window_end, host0)
-            return dataclasses.replace(st, now=window_end)
+        def body(carry):
+            st, nxt = carry
+            st = self._advance(st, nxt, stop, host0)
+            return st, self._next_time(st)
 
-        st = jax.lax.while_loop(cond, body, st)
+        st, _ = jax.lax.while_loop(cond, body, (st, self._next_time(st)))
         return dataclasses.replace(st, now=stop)
 
 

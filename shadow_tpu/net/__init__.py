@@ -1,0 +1,4 @@
+from shadow_tpu.net.topology import GraphNetwork, Topology
+from shadow_tpu.net.dns import DNS
+
+__all__ = ["GraphNetwork", "Topology", "DNS"]

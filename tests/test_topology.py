@@ -1,0 +1,176 @@
+"""Routing layer tests: GraphML parse, all-pairs paths, attachment, DNS.
+
+Models the reference's path semantics checks (SURVEY.md §2.3): complete
+graphs use direct edges, incomplete graphs use Dijkstra with multiplied
+per-hop reliability, self paths double the min incident edge.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.core.timebase import MILLISECOND
+from shadow_tpu.net.dns import DNS
+from shadow_tpu.net.topology import Topology, Vertex
+
+GRAPHML_1POI = """<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d6" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d5" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d1">2251</data><data key="d2">17038</data><data key="d4">0.0</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d5">50.0</data><data key="d6">0.001</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+# a 3-vertex line a - b - c (NOT complete): path a->c must go through b
+GRAPHML_LINE = """<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d6" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d5" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d4" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d1" />
+  <key attr.name="countrycode" attr.type="string" for="node" id="d3" />
+  <key attr.name="type" attr.type="string" for="node" id="d7" />
+  <graph edgedefault="undirected">
+    <node id="a"><data key="d1">1000</data><data key="d2">1000</data>
+      <data key="d4">0.01</data><data key="d3">US</data><data key="d7">client</data></node>
+    <node id="b"><data key="d1">1000</data><data key="d2">1000</data>
+      <data key="d4">0.0</data><data key="d3">US</data><data key="d7">relay</data></node>
+    <node id="c"><data key="d1">1000</data><data key="d2">1000</data>
+      <data key="d4">0.02</data><data key="d3">DE</data><data key="d7">client</data></node>
+    <edge source="a" target="b"><data key="d5">10.0</data><data key="d6">0.1</data></edge>
+    <edge source="b" target="c"><data key="d5">20.0</data><data key="d6">0.2</data></edge>
+  </graph>
+</graphml>"""
+
+
+def test_single_poi_self_loop():
+    top = Topology.from_graphml(GRAPHML_1POI)
+    assert top.n_vertices == 1
+    lat, rel = top.compute_all_pairs()
+    # complete graph (self-loop present): direct edge used
+    assert lat[0, 0] == pytest.approx(50.0)
+    assert rel[0, 0] == pytest.approx(1 - 0.001, abs=1e-6)
+    assert top.min_latency_ms == pytest.approx(50.0)
+
+
+def test_line_graph_paths():
+    top = Topology.from_graphml(GRAPHML_LINE)
+    lat, rel = top.compute_all_pairs()
+    a, b, c = 0, 1, 2
+    # two-hop latency adds; reliability multiplies edge AND endpoint vertex terms
+    assert lat[a, c] == pytest.approx(30.0)
+    expect = (1 - 0.01) * (1 - 0.1) * (1 - 0.2) * (1 - 0.02)
+    assert rel[a, c] == pytest.approx(expect, rel=1e-5)
+    assert lat[a, b] == pytest.approx(10.0)
+    assert rel[a, b] == pytest.approx((1 - 0.01) * (1 - 0.1), rel=1e-5)
+    # self path: min incident edge twice, edge loss only (topology.c:1545-1652)
+    assert lat[a, a] == pytest.approx(20.0)
+    assert rel[a, a] == pytest.approx((1 - 0.1) ** 2, rel=1e-5)
+    assert lat[b, b] == pytest.approx(20.0)
+
+
+def test_attachment_hints():
+    top = Topology.from_graphml(GRAPHML_LINE)
+    # country+type beats country alone
+    assert top.attach(countrycode_hint="US", type_hint="relay") == 1
+    assert top.attach(countrycode_hint="DE") == 2
+    # round-robin across the US class
+    seen = {top.attach(countrycode_hint="US") for _ in range(4)}
+    assert seen == {0, 1}
+    # unmatchable hints fall back to the all-class
+    v = top.attach(countrycode_hint="XX")
+    assert v in (0, 1, 2)
+
+
+def test_device_network_route():
+    top = Topology.from_graphml(GRAPHML_LINE)
+    # hosts: h0@a h1@a h2@c
+    net = top.build_network([0, 0, 2])
+    lat, rel = net.route(jnp.asarray([0, 0, 1]), jnp.asarray([2, 1, 0]))
+    assert int(lat[0]) == 30 * MILLISECOND
+    # h0 -> h1 both attach to vertex a: self path = 2 * 10ms
+    assert int(lat[1]) == 20 * MILLISECOND
+    assert int(lat[2]) == 20 * MILLISECOND
+    assert net.min_latency_ns == 10 * MILLISECOND
+
+
+def test_pointer_jump_matches_bruteforce():
+    """Random graphs: pointer-jumped path reliability == per-pair walk."""
+    rng = np.random.default_rng(7)
+    for trial in range(3):
+        v = 12
+        verts = [Vertex(vid=str(i), index=i) for i in range(v)]
+        edges = []
+        for i in range(v):
+            for j in range(i + 1, v):
+                if rng.random() < 0.35:
+                    edges.append(
+                        (i, j, float(rng.integers(1, 50)), float(rng.random() * 0.3), 0.0)
+                    )
+        # ensure connectivity via a ring
+        for i in range(v):
+            edges.append((i, (i + 1) % v, 60.0, 0.05, 0.0))
+        top = Topology(verts, edges)
+        lat, rel = top.compute_all_pairs()
+
+        import networkx as nx
+
+        g = nx.Graph()
+        g.add_nodes_from(range(v))
+        for u, w, l, loss, _ in edges:
+            if not g.has_edge(u, w) or g[u][w]["lat"] > l:
+                g.add_edge(u, w, lat=l, loss=loss)
+        for s in range(v):
+            lengths, paths = nx.single_source_dijkstra(g, s, weight="lat")
+            for d in range(v):
+                if d == s:
+                    continue
+                assert lat[s, d] == pytest.approx(lengths[d]), (s, d)
+                p = paths[d]
+                r = 1.0
+                for x, y in zip(p[:-1], p[1:]):
+                    r *= 1 - g[x][y]["loss"]
+                assert rel[s, d] == pytest.approx(r, rel=1e-4), (s, d)
+
+
+def test_reference_topology_loads():
+    """The shipped measured Internet topology parses and yields tables."""
+    import os
+
+    path = "/root/reference/resource/topology.graphml.xml.xz"
+    if not os.path.exists(path):
+        pytest.skip("reference topology not present")
+    top = Topology.from_graphml(path)
+    assert top.n_vertices > 10
+    lat, rel = top.compute_all_pairs()
+    assert np.isfinite(lat).all()
+    assert (rel > 0).all() and (rel <= 1).all()
+    # symmetric undirected measured graph -> symmetric latency
+    assert np.allclose(lat, lat.T)
+
+
+def test_dns():
+    dns = DNS()
+    a = dns.register(0, "alpha")
+    b = dns.register(1, "beta", requested_ip="11.0.0.50")
+    c = dns.register(2, "gamma", requested_ip="127.0.0.1")  # reserved -> auto
+    assert a.ip_str == "1.0.0.0"  # first counter value past the 0.0.0.0/8 block
+    assert b.ip_str == "11.0.0.50"
+    assert c.ip_str != "127.0.0.1"
+    assert dns.resolve_name("beta").host_id == 1
+    assert dns.resolve_ip("11.0.0.50").name == "beta"
+    assert dns.address_of(2) is c
+    with pytest.raises(ValueError):
+        dns.register(3, "alpha")
