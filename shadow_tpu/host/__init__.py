@@ -1,0 +1,4 @@
+from shadow_tpu.host.nic import NIC, CoDel
+from shadow_tpu.host.sockets import SocketTable
+
+__all__ = ["NIC", "CoDel", "SocketTable"]

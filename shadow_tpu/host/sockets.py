@@ -1,0 +1,98 @@
+"""Per-host socket tables: fixed-width slot arrays + vectorized demux.
+
+The reference gives each host a descriptor table of vtable'd Socket objects
+and demuxes arriving packets to them by a (protocol, port, peer) key with
+connection-specific entries taking precedence over wildcard binds
+(reference: src/main/host/network_interface.c:375-455 "_networkinterface
+_receivePacket" association lookup; src/main/host/descriptor/socket.c).
+
+Here every host owns S fixed socket slots; all hosts' tables are [H, S]
+arrays at rest and [S] slices inside vmapped handlers. Demux is a masked
+argmax over match scores, so one gather replaces the hash lookup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+PROTO_NONE = 0
+PROTO_UDP = 1
+PROTO_TCP = 2
+
+# First auto-assigned port (host.c:1058-1110 allocates random ports above
+# the reserved range; we assign deterministically per slot).
+EPHEMERAL_BASE = 10_000
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SocketTable:
+    """Socket slots (elementwise over trailing slot dim S).
+
+    peer_host == -1 means unconnected (wildcard receive on local_port).
+    rx_bytes / tx_bytes mirror the reference's per-socket byte accounting
+    (socket.h:47-78) for app logic and the tracker.
+    """
+
+    proto: jax.Array  # i32[S]
+    local_port: jax.Array  # i32[S]
+    peer_host: jax.Array  # i32[S]
+    peer_port: jax.Array  # i32[S]
+    rx_bytes: jax.Array  # i64[S]
+    tx_bytes: jax.Array  # i64[S]
+
+    @staticmethod
+    def create(n_hosts: int, n_sockets: int) -> "SocketTable":
+        """[H, S] table, all slots closed."""
+        shape = (n_hosts, n_sockets)
+        i32 = jnp.int32
+        return SocketTable(
+            proto=jnp.zeros(shape, i32),
+            local_port=jnp.zeros(shape, i32),
+            peer_host=jnp.full(shape, -1, i32),
+            peer_port=jnp.zeros(shape, i32),
+            rx_bytes=jnp.zeros(shape, jnp.int64),
+            tx_bytes=jnp.zeros(shape, jnp.int64),
+        )
+
+    def bind(self, host_row, slot, proto, port, peer_host=-1, peer_port=0):
+        """Open a socket in (host_row, slot) — setup-time op on the [H, S]
+        table (apps bind in their init, like process start tasks booting
+        listeners in the reference, host.c:773-900)."""
+        return SocketTable(
+            proto=self.proto.at[host_row, slot].set(proto),
+            local_port=self.local_port.at[host_row, slot].set(port),
+            peer_host=self.peer_host.at[host_row, slot].set(peer_host),
+            peer_port=self.peer_port.at[host_row, slot].set(peer_port),
+            rx_bytes=self.rx_bytes,
+            tx_bytes=self.tx_bytes,
+        )
+
+    # -- elementwise ops (per-host [S] slices under vmap) -------------------
+    def demux(self, proto, dst_port, src_host, src_port) -> jax.Array:
+        """Slot index receiving this packet, or -1.
+
+        Connection-specific (peer matches) beats wildcard-bound, matching
+        the reference's keyed lookup order (network_interface.c:375-455).
+        """
+        base = (self.proto == proto) & (self.local_port == dst_port)
+        exact = base & (self.peer_host == src_host) & (self.peer_port == src_port)
+        wild = base & (self.peer_host == -1)
+        score = exact.astype(jnp.int32) * 2 + wild.astype(jnp.int32)
+        best = jnp.argmax(score)
+        return jnp.where(score[best] > 0, best.astype(jnp.int32), jnp.int32(-1))
+
+    def add_rx(self, slot, nbytes):
+        ok = slot >= 0
+        idx = jnp.where(ok, slot, 0)
+        add = jnp.where(ok, jnp.asarray(nbytes, jnp.int64), 0)
+        return dataclasses.replace(self, rx_bytes=self.rx_bytes.at[idx].add(add))
+
+    def add_tx(self, slot, nbytes):
+        ok = slot >= 0
+        idx = jnp.where(ok, slot, 0)
+        add = jnp.where(ok, jnp.asarray(nbytes, jnp.int64), 0)
+        return dataclasses.replace(self, tx_bytes=self.tx_bytes.at[idx].add(add))

@@ -53,9 +53,17 @@ class DNS:
 
     def _generate_ip(self) -> int:
         self._counter += 1
-        while _is_restricted(self._counter) or self._counter in self._by_ip:
-            self._counter += 1
-        return self._counter
+        while True:
+            a = ipaddress.ip_address(self._counter)
+            hit = next((n for n in _RESERVED if a in n), None)
+            if hit is not None:
+                # leap the whole reserved block instead of walking it
+                self._counter = int(hit.broadcast_address) + 1
+                continue
+            if self._counter in self._by_ip:
+                self._counter += 1
+                continue
+            return self._counter
 
     def register(self, host_id: int, name: str, requested_ip: str | None = None) -> Address:
         """Register a host; honors a requested IP if it is usable, else

@@ -31,8 +31,8 @@ Semantics reproduced from the reference:
 from __future__ import annotations
 
 import dataclasses
-import io
 import lzma
+import os
 from typing import Sequence
 
 import jax
@@ -89,8 +89,11 @@ class Topology:
         if nx is None:  # pragma: no cover
             raise RuntimeError("networkx unavailable")
         data = text_or_path
+        if not isinstance(data, str):
+            data = os.fspath(data)
         if "\n" not in data and "<" not in data:
-            raw = open(data, "rb").read()
+            with open(data, "rb") as f:
+                raw = f.read()
             if data.endswith(".xz"):
                 raw = lzma.decompress(raw)
             data = raw.decode()
@@ -115,10 +118,7 @@ class Topology:
             )
         idx = {v.vid: v.index for v in verts}
         edges = []
-        edge_iter = (
-            g.edges(data=True) if not g.is_multigraph() else g.edges(data=True)
-        )
-        for u, v, attrs in edge_iter:
+        for u, v, attrs in g.edges(data=True):
             edges.append(
                 (
                     idx[str(u)],

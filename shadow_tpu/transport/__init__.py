@@ -1,0 +1,17 @@
+from shadow_tpu.transport.stack import (
+    HostNet,
+    Pkt,
+    Stack,
+    KIND_PKT_ARRIVE,
+    KIND_PKT_RX,
+    N_STACK_KINDS,
+)
+
+__all__ = [
+    "HostNet",
+    "Pkt",
+    "Stack",
+    "KIND_PKT_ARRIVE",
+    "KIND_PKT_RX",
+    "N_STACK_KINDS",
+]

@@ -1,0 +1,269 @@
+"""The host network stack: packet events, delivery pipeline, send path.
+
+Reference pipeline per packet (SURVEY.md §3.2-3.3): socket flush ->
+qdisc/token-bucket send (network_interface.c:519-579) -> worker_sendPacket
+(latency + reliability + barrier clamp, worker.c:243-304) -> dst router
+CoDel enqueue (router.c:96-133) -> NIC token-bucket receive
+(network_interface.c:192-226) -> socket demux (:375-455) -> transport
+processPacket -> app wakeup via epoll.
+
+TPU-native pipeline, two event hops per packet:
+
+  sender handler:  tx-NIC virtual clock -> Emit(dst, dt=serialize delay)
+  [engine routes: + path latency, reliability roll, window clamp]
+  KIND_PKT_ARRIVE @ dst: rx-NIC virtual clock gives (start, finish);
+      sojourn = start - arrival feeds CoDel -> maybe drop;
+      else local Emit at dt = finish-now, kind = KIND_PKT_RX
+  KIND_PKT_RX @ dst: socket demux -> protocol dispatch (UDP: count bytes,
+      app on_recv callback; TCP: segment processing via the tcp hook)
+
+Packet metadata rides the event's i32 args; payload *bytes* never exist on
+device — only lengths (the reference similarly keeps Payload refs out of
+headers, packet.c:40-63; one app payload word can ride the aux field).
+The local ARRIVE->RX re-emit would lose the sender's identity (ev.src of a
+local event is the host itself), so the arrive handler stashes the true
+source id in the A_SRC arg word.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.events import Events
+from shadow_tpu.host.nic import HEADER_TCP, HEADER_UDP, NIC, CoDel
+from shadow_tpu.host.sockets import PROTO_TCP, PROTO_UDP, SocketTable
+
+# ---------------------------------------------------------------------------
+# Packet arg layout: 9 i32 words.
+N_PKT_ARGS = 9
+A_META = 0  # proto | tcp flags (bit-packed, see below)
+A_SPORT = 1
+A_DPORT = 2
+A_SEQ = 3  # TCP: segment sequence number (in segments)
+A_ACK = 4  # TCP: cumulative ack (in segments)
+A_LEN = 5  # payload bytes
+A_WND = 6  # TCP: advertised receive window (segments)
+A_AUX = 7  # timestamp echo (ms) / app payload word
+A_SRC = 8  # original source host id (stashed across the local rx re-emit)
+
+F_SYN = 1 << 2
+F_ACK = 1 << 3
+F_FIN = 1 << 4
+F_RST = 1 << 5
+
+KIND_PKT_ARRIVE = 0
+KIND_PKT_RX = 1
+N_STACK_KINDS = 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Pkt:
+    """Decoded packet metadata (scalars inside a vmapped handler)."""
+
+    proto: jax.Array
+    flags: jax.Array
+    src_host: jax.Array
+    src_port: jax.Array
+    dst_port: jax.Array
+    seq: jax.Array
+    ack: jax.Array
+    length: jax.Array
+    wnd: jax.Array
+    aux: jax.Array
+
+    @staticmethod
+    def decode(ev: Events) -> "Pkt":
+        """Decode a KIND_PKT_RX event (src from the stashed arg word)."""
+        a = ev.args
+        return Pkt(
+            proto=a[A_META] & 0x3,
+            flags=a[A_META],
+            src_host=a[A_SRC],
+            src_port=a[A_SPORT],
+            dst_port=a[A_DPORT],
+            seq=a[A_SEQ],
+            ack=a[A_ACK],
+            length=a[A_LEN],
+            wnd=a[A_WND],
+            aux=a[A_AUX],
+        )
+
+    @staticmethod
+    def encode_args(proto, sport, dport, seq=0, ack=0, length=0, wnd=0,
+                    aux=0, flags=0):
+        """i32[N_PKT_ARGS] args vector for an Emit."""
+        meta = jnp.asarray(proto, jnp.int32) | jnp.asarray(flags, jnp.int32)
+        mk = lambda x: jnp.broadcast_to(jnp.asarray(x, jnp.int32), meta.shape)
+        return jnp.stack(
+            [meta, mk(sport), mk(dport), mk(seq), mk(ack), mk(length),
+             mk(wnd), mk(aux), mk(0)],
+            axis=-1,
+        ).reshape(meta.shape + (N_PKT_ARGS,)) if meta.ndim else jnp.stack(
+            [meta, mk(sport), mk(dport), mk(seq), mk(ack), mk(length),
+             mk(wnd), mk(aux), mk(0)]
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HostNet:
+    """Per-host network-stack state bundle ([H]-leading at rest).
+
+    The composition mirrors Host's members: NIC both directions, upstream
+    router AQM, socket table (host.c:76-91,199-206).
+    """
+
+    nic_tx: NIC
+    nic_rx: NIC
+    codel: CoDel
+    sockets: SocketTable
+
+    @staticmethod
+    def create(n_hosts: int, n_sockets: int, bw_up_kib, bw_down_kib) -> "HostNet":
+        up = jnp.broadcast_to(jnp.asarray(bw_up_kib), (n_hosts,))
+        down = jnp.broadcast_to(jnp.asarray(bw_down_kib), (n_hosts,))
+        return HostNet(
+            nic_tx=NIC.create(up),
+            nic_rx=NIC.create(down),
+            codel=CoDel.create(n_hosts),
+            sockets=SocketTable.create(n_hosts, n_sockets),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SimHost:
+    """Default host-state shape: network stack + app pytree."""
+
+    net: HostNet
+    app: Any
+
+
+# App receive callback: (host_state, slot, Pkt, now, key) -> (host_state',
+# Emit). It sees the full host state, so replies can go straight through
+# Stack.send_udp / tcp ops.
+OnRecvHost = Callable[[Any, jax.Array, "Pkt", jax.Array, jax.Array], tuple[Any, Emit]]
+
+
+class Stack:
+    """Builds the packet-pipeline handlers and the send-path helpers.
+
+    Host state seen by handlers must be a pytree with `.net: HostNet` and
+    `.app` attributes (use `SimHost` or any compatible dataclass).
+    """
+
+    def __init__(self, *, bootstrap_end: int = 0, tcp=None):
+        self.bootstrap_end = bootstrap_end  # unlimited-bandwidth phase end
+        self.tcp = tcp  # TCP protocol hook (transport.tcp.TCP instance)
+
+    # ---------------------------------------------------------------- send
+    def send_udp(self, hs, now, slot, dst_host, dst_port, nbytes,
+                 aux=0, mask=True):
+        """One UDP datagram through the tx NIC; returns (hs', Emit).
+
+        Serialization delay = wire bytes / up-bandwidth from the virtual
+        clock (fluid token bucket, network_interface.c:519-579 semantics);
+        the engine then adds path latency and rolls reliability.
+        """
+        net: HostNet = hs.net
+        unlimited = now < self.bootstrap_end
+        wire = jnp.asarray(nbytes, jnp.int32) + HEADER_UDP
+        nic_tx, _start, finish = net.nic_tx.admit(now, wire, unlimited)
+        # only advance the NIC clock if this send actually happens
+        nic_tx = jax.tree.map(
+            lambda n, o: jnp.where(mask, n, o), nic_tx, net.nic_tx
+        )
+        sport = net.sockets.local_port[slot]
+        # socket counters track app payload; wire overhead is charged to
+        # the NIC only (the reference's tracker splits payload vs header
+        # bytes the same way, tracker.c:433-479)
+        sockets = net.sockets.add_tx(jnp.where(mask, slot, -1), nbytes)
+        hs = dataclasses.replace(
+            hs, net=dataclasses.replace(net, nic_tx=nic_tx, sockets=sockets)
+        )
+        args = Pkt.encode_args(PROTO_UDP, sport, dst_port, length=nbytes, aux=aux)
+        em = Emit.single(
+            dst=dst_host,
+            dt=finish - now,
+            kind=KIND_PKT_ARRIVE,
+            args=args,
+            mask=mask,
+            n_args=N_PKT_ARGS,
+        )
+        return hs, em
+
+    # ------------------------------------------------------------ handlers
+    def make_handlers(self, on_recv: OnRecvHost):
+        """[KIND_PKT_ARRIVE, KIND_PKT_RX] handler pair.
+
+        `on_recv(hs, slot, pkt, now, key) -> (hs', Emit)` is invoked for
+        demuxed UDP payload deliveries (and TCP app-data deliveries when a
+        tcp hook is installed).
+        """
+
+        def on_arrive(hs, ev: Events, key):
+            # Router enqueue + rx-NIC dequeue scheduling + CoDel verdict.
+            # The packet reached the host edge at ev.time; its rx start is
+            # the NIC virtual clock; sojourn (start - arrival) is the
+            # standing queue delay CoDel controls on
+            # (router_queue_codel.c:198-267).
+            net: HostNet = hs.net
+            now = ev.time
+            # rate-limit on wire bytes (payload + header), matching the tx
+            # side — the reference's token buckets charge total packet size
+            # in both directions (network_interface.c:192-226)
+            proto = ev.args[A_META] & 0x3
+            header = jnp.where(proto == PROTO_TCP, HEADER_TCP, HEADER_UDP)
+            wire = ev.args[A_LEN] + header
+            unlimited = now < self.bootstrap_end
+            nic_rx, start, finish = net.nic_rx.admit(now, wire, unlimited)
+            sojourn = start - now
+            codel, drop = net.codel.on_dequeue(start, sojourn)
+            drop = drop & ~unlimited
+            # a dropped packet never occupies the link
+            nic_rx = jax.tree.map(
+                lambda n, o: jnp.where(drop, o, n), nic_rx, net.nic_rx
+            )
+            codel = jax.tree.map(
+                lambda n, o: jnp.where(unlimited, o, n), codel, net.codel
+            )
+            hs = dataclasses.replace(
+                hs, net=dataclasses.replace(net, nic_rx=nic_rx, codel=codel)
+            )
+            args = ev.args.at[A_SRC].set(ev.src)  # stash true source
+            em = Emit.single(
+                dst=ev.dst,
+                dt=finish - now,
+                kind=KIND_PKT_RX,
+                mask=~drop,
+                local=True,
+                n_args=N_PKT_ARGS,
+            )
+            em = dataclasses.replace(em, args=args[None, :])
+            return hs, em
+
+        def on_rx(hs, ev: Events, key):
+            # Socket demux + protocol dispatch (network_interface.c:375-455
+            # -> udp_processPacket / tcp_processPacket).
+            net: HostNet = hs.net
+            pkt = Pkt.decode(ev)
+            slot = net.sockets.demux(
+                pkt.proto, pkt.dst_port, pkt.src_host, pkt.src_port
+            )
+            sockets = net.sockets.add_rx(slot, pkt.length)
+            hs = dataclasses.replace(
+                hs, net=dataclasses.replace(net, sockets=sockets)
+            )
+            if self.tcp is not None:
+                return self.tcp.process_segment(
+                    self, hs, slot, pkt, ev, key, on_recv
+                )
+            return on_recv(hs, slot, pkt, ev.time, key)
+
+        return [on_arrive, on_rx]
