@@ -97,14 +97,10 @@ class Pkt:
     @staticmethod
     def encode_args(proto, sport, dport, seq=0, ack=0, length=0, wnd=0,
                     aux=0, flags=0):
-        """i32[N_PKT_ARGS] args vector for an Emit."""
+        """i32[N_PKT_ARGS] args vector for an Emit (scalar fields)."""
         meta = jnp.asarray(proto, jnp.int32) | jnp.asarray(flags, jnp.int32)
         mk = lambda x: jnp.broadcast_to(jnp.asarray(x, jnp.int32), meta.shape)
         return jnp.stack(
-            [meta, mk(sport), mk(dport), mk(seq), mk(ack), mk(length),
-             mk(wnd), mk(aux), mk(0)],
-            axis=-1,
-        ).reshape(meta.shape + (N_PKT_ARGS,)) if meta.ndim else jnp.stack(
             [meta, mk(sport), mk(dport), mk(seq), mk(ack), mk(length),
              mk(wnd), mk(aux), mk(0)]
         )
@@ -123,16 +119,24 @@ class HostNet:
     nic_rx: NIC
     codel: CoDel
     sockets: SocketTable
+    tcb: Any = None  # transport.tcp.TCB [H, S] when TCP is installed
 
     @staticmethod
-    def create(n_hosts: int, n_sockets: int, bw_up_kib, bw_down_kib) -> "HostNet":
+    def create(n_hosts: int, n_sockets: int, bw_up_kib, bw_down_kib,
+               with_tcp: bool = False) -> "HostNet":
         up = jnp.broadcast_to(jnp.asarray(bw_up_kib), (n_hosts,))
         down = jnp.broadcast_to(jnp.asarray(bw_down_kib), (n_hosts,))
+        tcb = None
+        if with_tcp:
+            from shadow_tpu.transport.tcp import TCB
+
+            tcb = TCB.create(n_hosts, n_sockets)
         return HostNet(
             nic_tx=NIC.create(up),
             nic_rx=NIC.create(down),
             codel=CoDel.create(n_hosts),
             sockets=SocketTable.create(n_hosts, n_sockets),
+            tcb=tcb,
         )
 
 
@@ -241,11 +245,11 @@ class Stack:
                 dst=ev.dst,
                 dt=finish - now,
                 kind=KIND_PKT_RX,
+                args=args,
                 mask=~drop,
                 local=True,
                 n_args=N_PKT_ARGS,
             )
-            em = dataclasses.replace(em, args=args[None, :])
             return hs, em
 
         def on_rx(hs, ev: Events, key):
@@ -256,14 +260,19 @@ class Stack:
             slot = net.sockets.demux(
                 pkt.proto, pkt.dst_port, pkt.src_host, pkt.src_port
             )
+            if self.tcp is not None:
+                # the TCP hook owns byte accounting (it counts delivered
+                # bytes, not raw arrivals) and routes UDP through on_recv
+                return self.tcp.process_segment(
+                    self, hs, slot, pkt, ev, key, on_recv
+                )
             sockets = net.sockets.add_rx(slot, pkt.length)
             hs = dataclasses.replace(
                 hs, net=dataclasses.replace(net, sockets=sockets)
             )
-            if self.tcp is not None:
-                return self.tcp.process_segment(
-                    self, hs, slot, pkt, ev, key, on_recv
-                )
             return on_recv(hs, slot, pkt, ev.time, key)
 
-        return [on_arrive, on_rx]
+        handlers = [on_arrive, on_rx]
+        if self.tcp is not None:
+            handlers += self.tcp.make_handlers(self)
+        return handlers

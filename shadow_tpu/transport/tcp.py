@@ -1,0 +1,972 @@
+"""TCP as a vectorized per-connection state table.
+
+The reference implements a full TCP state machine as ~2.5k lines of
+per-socket pointer code: 11 connection states, listen/accept child
+multiplexing, seq/ack windows, RTO timers with Karn/Jacobson RTT
+estimation, fast retransmit/recovery, and pluggable congestion control
+(reference: src/main/host/descriptor/tcp.c:42-53 states, :925-1065 RTO/RTT,
+:1777 tcp_processPacket, :91-113 TCPServer/TCPChild; tcp_cong_reno.c:13-60
+reno hook tables; interval bookkeeping in C++ tcp_retransmit_tally.cc).
+
+TPU-native redesign:
+
+- **Sequence space is MSS-sized segments**, not bytes: seq/ack/window
+  arithmetic is small-integer, the receive reassembly buffer is one u64
+  bitmap per connection, and the C++ interval tally collapses into bit
+  tricks (trailing-ones of the bitmap = in-order advance). Stream byte
+  positions are recovered from the connection's byte counter `snd_buf`:
+  segment s spans bytes [s*MSS, min((s+1)*MSS, snd_buf)).
+- All connections of all hosts form one [H, S] struct-of-arrays TCB table;
+  every transition is an elementwise masked update inside the vmapped
+  event handlers — no branches, no per-connection heap objects.
+- **Timers are events** carrying (slot, generation, kind); a fired timer
+  whose generation mismatches the TCB's is stale and ignored (the
+  reference invalidates timers with expire IDs the same way,
+  src/main/host/descriptor/timer.c:23-42). The RTO timer is lazily
+  rescheduled: if it fires before the current deadline (the deadline was
+  pushed forward by an ACK), it re-emits itself at the new deadline, so at
+  most one timer event per connection is ever in flight.
+- Transmission is ACK-clocked + self-kicked: handlers send up to a static
+  burst of segments through the tx-NIC virtual clock and emit a local
+  KIND_TCP_TX continuation when the window allows more, paced at the NIC
+  free time (the reference's _tcp_flush + wantsSend loop, tcp.c:1121,
+  network_interface.c:519-579).
+
+Fidelity notes (deliberate v1 deviations from the reference):
+- Immediate ACKs (no delayed-ACK timer yet; reference tcp.c delack).
+- Fixed advertised window = RCV_WND segments (no buffer autotuning,
+  reference tcp.c:407-598) — sim apps consume on arrival, so the receive
+  buffer never fills.
+- Application delivery is on-arrival (deduplicated by the seq bitmap)
+  rather than strictly in-order; rcv_nxt still governs ACK generation, so
+  sender dynamics (goodput, retransmits, congestion) are unaffected.
+- NewReno without SACK scoreboard: partial ACKs retransmit snd_una.
+- A refilled partial segment is tracked for exactly one outstanding
+  partial (the common request/response case); overlapping multiple
+  partials under-deliver bytes to the app counter only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.timebase import MILLISECOND, SECOND
+from shadow_tpu.host.nic import HEADER_TCP, MTU
+from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP, PROTO_UDP
+from shadow_tpu.transport.stack import (
+    A_LEN,
+    F_ACK,
+    F_FIN,
+    F_RST,
+    F_SYN,
+    KIND_PKT_ARRIVE,
+    N_PKT_ARGS,
+    N_STACK_KINDS,
+    Pkt,
+)
+
+# App bytes per full segment (definitions.h:188 MTU minus TCP/IP/eth headers).
+MSS = MTU - HEADER_TCP  # 1434
+
+# Connection states (tcp.c:42-53).
+CLOSED = 0
+LISTEN = 1
+SYN_SENT = 2
+SYN_RCVD = 3
+ESTABLISHED = 4
+FIN_WAIT_1 = 5
+FIN_WAIT_2 = 6
+CLOSE_WAIT = 7
+CLOSING = 8
+LAST_ACK = 9
+TIME_WAIT = 10
+
+# Timing constants (definitions.h:123-125,198).
+RTO_INIT = SECOND
+RTO_MIN = SECOND // 5
+RTO_MAX = 120 * SECOND
+TIME_WAIT_DELAY = 60 * SECOND
+INIT_CWND = 10.0
+INIT_SSTHRESH = 64.0
+CWND_MAX = 1024.0
+RCV_WND = 64  # segments: the reassembly bitmap width & advertised window
+
+# Event kinds provided by this module (appended after the stack's).
+KIND_TCP_TIMER = N_STACK_KINDS  # 2
+KIND_TCP_TX = N_STACK_KINDS + 1  # 3
+N_TCP_KINDS = N_STACK_KINDS + 2
+
+# Timer/kick event arg words.
+T_SLOT = 0
+T_GEN = 1
+T_KIND = 2
+TK_RTO = 0
+TK_TIMEWAIT = 1
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TCB:
+    """Per-connection state, [H, S] at rest, scalar rows inside handlers.
+
+    Replaces the reference's per-socket TCP struct (tcp.c:125-190 seq/ack
+    block, :175-190 retransmit block, tcp_cong.h cwnd).
+    """
+
+    state: jax.Array  # i32
+    snd_una: jax.Array  # i32 first unacked segment
+    snd_nxt: jax.Array  # i32 next segment to send
+    snd_buf: jax.Array  # i64 total bytes written by the app
+    fin_pending: jax.Array  # bool app closed; FIN occupies seq n_segs
+    rcv_nxt: jax.Array  # i32 next expected segment
+    ooo: jax.Array  # u64 bitmap: bit i = segment rcv_nxt+i received
+    rfin_seq: jax.Array  # i32 peer FIN's seq (-1 none)
+    partial_seq: jax.Array  # i32 last partial segment delivered (-1 none)
+    partial_len: jax.Array  # i32 bytes delivered for it
+    cwnd: jax.Array  # f32 congestion window (segments)
+    ssthresh: jax.Array  # f32
+    dup_acks: jax.Array  # i32
+    recover: jax.Array  # i32 NewReno recovery point (-1 = open)
+    srtt: jax.Array  # i64 ns (0 = no sample yet)
+    rttvar: jax.Array  # i64 ns
+    rto: jax.Array  # i64 ns
+    rto_deadline: jax.Array  # i64 current retransmit deadline
+    timer_live: jax.Array  # bool a timer event is in flight
+    timer_gen: jax.Array  # i32 generation for stale-timer rejection
+    peer_wnd: jax.Array  # i32 advertised window (segments)
+    n_retx: jax.Array  # i32 retransmitted segments (observability)
+
+    @staticmethod
+    def create(n_hosts: int, n_sockets: int) -> "TCB":
+        s = (n_hosts, n_sockets)
+        zi = jnp.zeros(s, _I32)
+        zl = jnp.zeros(s, _I64)
+        zb = jnp.zeros(s, bool)
+        return TCB(
+            state=zi,
+            snd_una=zi,
+            snd_nxt=zi,
+            snd_buf=zl,
+            fin_pending=zb,
+            rcv_nxt=zi,
+            ooo=jnp.zeros(s, jnp.uint64),
+            rfin_seq=jnp.full(s, -1, _I32),
+            partial_seq=jnp.full(s, -1, _I32),
+            partial_len=zi,
+            cwnd=jnp.full(s, INIT_CWND, jnp.float32),
+            ssthresh=jnp.full(s, INIT_SSTHRESH, jnp.float32),
+            dup_acks=zi,
+            recover=jnp.full(s, -1, _I32),
+            srtt=zl,
+            rttvar=zl,
+            rto=jnp.full(s, RTO_INIT, _I64),
+            rto_deadline=zl,
+            timer_live=zb,
+            timer_gen=zi,
+            peer_wnd=jnp.full(s, RCV_WND, _I32),
+            n_retx=zi,
+        )
+
+    def listen(self, host: int, slot: int) -> "TCB":
+        """Setup-time op on the [H, S] table: mark a listening socket
+        (pair with SocketTable.bind(host, slot, PROTO_TCP, port))."""
+        return dataclasses.replace(
+            self, state=self.state.at[host, slot].set(LISTEN)
+        )
+
+
+def _row(tcb, c):
+    return jax.tree.map(lambda a: a[c], tcb)
+
+
+def _write_row(tcb, c, new, mask):
+    return jax.tree.map(
+        lambda a, n: a.at[c].set(jnp.where(mask, n, a[c])), tcb, new
+    )
+
+
+def _fresh_row_like(old: TCB) -> TCB:
+    """Default-valued scalar row preserving timer_gen (so stale timer
+    events from a previous connection on this slot never match)."""
+    z32 = jnp.int32(0)
+    return TCB(
+        state=z32,
+        snd_una=z32,
+        snd_nxt=z32,
+        snd_buf=jnp.int64(0),
+        fin_pending=jnp.asarray(False),
+        rcv_nxt=z32,
+        ooo=jnp.uint64(0),
+        rfin_seq=jnp.int32(-1),
+        partial_seq=jnp.int32(-1),
+        partial_len=z32,
+        cwnd=jnp.float32(INIT_CWND),
+        ssthresh=jnp.float32(INIT_SSTHRESH),
+        dup_acks=z32,
+        recover=jnp.int32(-1),
+        srtt=jnp.int64(0),
+        rttvar=jnp.int64(0),
+        rto=jnp.int64(RTO_INIT),
+        rto_deadline=jnp.int64(0),
+        timer_live=jnp.asarray(False),
+        timer_gen=old.timer_gen,
+        peer_wnd=jnp.int32(RCV_WND),
+        n_retx=old.n_retx,
+    )
+
+
+def _n_segs(snd_buf):
+    return ((snd_buf + MSS - 1) // MSS).astype(_I32)
+
+
+def _outstanding(row) -> jax.Array:
+    """True while the connection still needs timer coverage: unacked
+    flight, queued-but-unsent data or FIN, or a handshake in progress.
+    (A timer that dies with work pending strands the connection if the
+    last in-flight packet is lost.)"""
+    lim = _n_segs(row.snd_buf) + row.fin_pending.astype(_I32)
+    return (
+        (row.snd_nxt > row.snd_una)
+        | ((row.snd_una < lim) & (row.state >= ESTABLISHED))
+        | (row.state == SYN_SENT)
+        | (row.state == SYN_RCVD)
+    )
+
+
+def _seg_len(snd_buf, s):
+    return jnp.clip(snd_buf - s.astype(_I64) * MSS, 0, MSS).astype(_I32)
+
+
+def _trailing_ones(x):
+    """Count of consecutive set bits from bit 0 of a u64 (all-ones -> 64).
+
+    This is the whole of the in-order-advance computation that the
+    reference's C++ interval tally performs with std::vector range merges
+    (tcp_retransmit_tally.cc)."""
+    y = (x + jnp.uint64(1)).astype(jnp.uint64)
+    return jax.lax.population_count((y & (~y + jnp.uint64(1))) - jnp.uint64(1)).astype(_I32)
+
+
+def _ts_us(now):
+    """Nonzero i32 microsecond timestamp for the header ts/echo word
+    (tcp.c header timestamps for RTT)."""
+    return jnp.maximum((now // 1000) & 0x7FFFFFFF, 1).astype(_I32)
+
+
+def _pkt_args(sport, dport, seq=0, ack=0, length=0, wnd=RCV_WND, aux=0, flags=0):
+    return Pkt.encode_args(
+        PROTO_TCP, sport, dport, seq=seq, ack=ack, length=length, wnd=wnd,
+        aux=aux, flags=flags,
+    )
+
+
+def _ctl_args(slot, gen_or_zero, tk=0):
+    f = lambda x: jnp.asarray(x, _I32)
+    z = jnp.int32(0)
+    return jnp.stack([f(slot), f(gen_or_zero), f(tk), z, z, z, z, z, z])
+
+
+def _emit_from_rows(rows):
+    stk = lambda key, dt: jnp.stack([jnp.asarray(r[key], dt) for r in rows])
+    return Emit(
+        dst=stk("dst", _I32),
+        dt=stk("dt", _I64),
+        kind=stk("kind", _I32),
+        args=jnp.stack([r["args"] for r in rows]),
+        mask=stk("mask", bool),
+        local=stk("local", bool),
+    )
+
+
+def emit_concat(*ems: Emit) -> Emit:
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs), *ems)
+
+
+class TCP:
+    """The TCP protocol hook installed into `transport.stack.Stack`.
+
+    tx_burst: segments sent per KIND_TCP_TX kick (static unroll).
+    inline_budget: segments sent inline from the ACK-processing path.
+    auto_close: a connection reaching CLOSE_WAIT closes itself (the typical
+      sim-server behavior; apps may instead close explicitly).
+
+    Engine `max_emit` must be >= `min_max_emit(app_rows)` where app_rows is
+    the number of Emit rows the installed on_recv callback returns.
+    """
+
+    def __init__(self, tx_burst: int = 4, inline_budget: int = 2,
+                 auto_close: bool = True):
+        self.tx_burst = tx_burst
+        self.inline_budget = inline_budget
+        self.auto_close = auto_close
+
+    def min_max_emit(self, app_rows: int = 1) -> int:
+        """Smallest EngineConfig.max_emit that fits this TCP's handlers.
+
+        process_segment emits [ctl, retx] + inline_budget data rows +
+        [kick, timer] plus the on_recv callback's rows (>= 1, since
+        on_recv must return an Emit)."""
+        return max(self.tx_burst + 2, self.inline_budget + 4 + app_rows)
+
+    # ------------------------------------------------------------ helpers
+    def _seg_row(self, nic_tx, row, now, dst_host, sport, dport, s, is_fin,
+                 ok, unlimited):
+        """One data/FIN segment through the tx NIC; returns
+        (nic_tx', emit_row)."""
+        length = jnp.where(is_fin, 0, _seg_len(row.snd_buf, s))
+        wire = length + HEADER_TCP
+        nic2, _start, fin_t = nic_tx.admit(now, wire, unlimited)
+        nic_tx = jax.tree.map(lambda n, o: jnp.where(ok, n, o), nic2, nic_tx)
+        flags = F_ACK | jnp.where(is_fin, F_FIN, 0)
+        args = _pkt_args(
+            sport, dport, seq=s, ack=row.rcv_nxt, length=length,
+            aux=_ts_us(now), flags=flags,
+        )
+        em = dict(
+            dst=dst_host, dt=jnp.where(ok, fin_t - now, 0),
+            kind=KIND_PKT_ARRIVE, args=args, mask=ok, local=False,
+        )
+        return nic_tx, em
+
+    def _tx_segments(self, nic_tx, row, now, dst_host, sport, dport, budget,
+                     enabled, unlimited):
+        """Send up to `budget` new segments from snd_nxt (window-limited).
+
+        Returns (nic_tx', row', rows, more). State moves to FIN_WAIT_1 /
+        LAST_ACK when the FIN goes out (tcp.c _tcp_flush semantics)."""
+        n_segs = _n_segs(row.snd_buf)
+        lim = n_segs + row.fin_pending.astype(_I32)
+        # closing states stay sendable so a post-timeout go-back-N window
+        # (snd_nxt rewound below old flight) can refill with a full cwnd
+        # instead of one segment per RTO
+        can = enabled & (
+            (row.state == ESTABLISHED) | (row.state == CLOSE_WAIT)
+            | (row.state == FIN_WAIT_1) | (row.state == CLOSING)
+            | (row.state == LAST_ACK)
+        )
+        win = jnp.minimum(row.cwnd.astype(_I32), row.peer_wnd)
+        nxt = row.snd_nxt
+        sent_fin = jnp.asarray(False)
+        rows = []
+        for _ in range(budget):
+            s = nxt
+            is_data = s < n_segs
+            is_fin = row.fin_pending & ~is_data & (s == n_segs)
+            ok = can & (is_data | is_fin) & (s < row.snd_una + win) & (s < lim)
+            nic_tx, em = self._seg_row(
+                nic_tx, row, now, dst_host, sport, dport, s, is_fin, ok,
+                unlimited,
+            )
+            rows.append(em)
+            nxt = nxt + ok.astype(_I32)
+            sent_fin = sent_fin | (ok & is_fin)
+        state = jnp.where(
+            sent_fin & (row.state == ESTABLISHED), FIN_WAIT_1,
+            jnp.where(sent_fin & (row.state == CLOSE_WAIT), LAST_ACK, row.state),
+        )
+        row = dataclasses.replace(row, snd_nxt=nxt, state=state)
+        more = can & (nxt < lim) & (nxt < row.snd_una + win)
+        return nic_tx, row, rows, more
+
+    def _kick_row(self, slot, now, free_at, mask):
+        return dict(
+            dst=0, dt=jnp.maximum(free_at - now, 1), kind=KIND_TCP_TX,
+            args=_ctl_args(slot, 0), mask=mask, local=True,
+        )
+
+    def _arm_row(self, row, slot, now, enter_tw):
+        """RTO arm (when outstanding data and no live timer) or TIME_WAIT
+        timer (on entering TIME_WAIT); at most one fires per event."""
+        arm = _outstanding(row) & ~row.timer_live & ~enter_tw
+        fire = arm | enter_tw
+        gen = row.timer_gen + fire.astype(_I32)
+        tk = jnp.where(enter_tw, TK_TIMEWAIT, TK_RTO)
+        dt = jnp.where(enter_tw, TIME_WAIT_DELAY, row.rto)
+        row = dataclasses.replace(
+            row,
+            timer_live=row.timer_live | fire,
+            timer_gen=gen,
+            rto_deadline=jnp.where(arm, now + row.rto, row.rto_deadline),
+        )
+        em = dict(
+            dst=0, dt=dt, kind=KIND_TCP_TIMER,
+            args=_ctl_args(slot, gen, tk), mask=fire, local=True,
+        )
+        return row, em
+
+    # --------------------------------------------------------- public API
+    def connect(self, stack, hs, slot, now, mask=True):
+        """Active open (tcp_connectToPeer). The socket at `slot` must be
+        bound with proto=TCP, a local port, and the peer set. Returns
+        (hs', Emit[2]) = SYN + RTO timer."""
+        net = hs.net
+        c = jnp.maximum(jnp.asarray(slot, _I32), 0)
+        mask = jnp.asarray(mask, bool) & (jnp.asarray(slot, _I32) >= 0)
+        old = _row(net.tcb, c)
+        row = _fresh_row_like(old)
+        row = dataclasses.replace(
+            row,
+            state=jnp.int32(SYN_SENT),
+            timer_live=jnp.asarray(True),
+            timer_gen=old.timer_gen + 1,
+            rto_deadline=now + RTO_INIT,
+        )
+        unlimited = now < stack.bootstrap_end
+        nic2, _s, fin_t = net.nic_tx.admit(now, HEADER_TCP, unlimited)
+        nic_tx = jax.tree.map(
+            lambda n, o: jnp.where(mask, n, o), nic2, net.nic_tx
+        )
+        syn = dict(
+            dst=net.sockets.peer_host[c],
+            dt=jnp.where(mask, fin_t - now, 0),
+            kind=KIND_PKT_ARRIVE,
+            args=_pkt_args(
+                net.sockets.local_port[c], net.sockets.peer_port[c],
+                aux=_ts_us(now), flags=F_SYN,
+            ),
+            mask=mask, local=False,
+        )
+        timer = dict(
+            dst=0, dt=jnp.int64(RTO_INIT), kind=KIND_TCP_TIMER,
+            args=_ctl_args(c, row.timer_gen, TK_RTO), mask=mask, local=True,
+        )
+        tcb = _write_row(net.tcb, c, row, mask)
+        hs = dataclasses.replace(
+            hs, net=dataclasses.replace(net, tcb=tcb, nic_tx=nic_tx)
+        )
+        return hs, _emit_from_rows([syn, timer])
+
+    def send(self, hs, slot, nbytes, now, mask=True):
+        """Queue bytes on the connection (host_sendUserData ->
+        tcp_sendUserData). Returns (hs', Emit[1]) = a tx kick.
+
+        If the previously-final segment was partial and already
+        transmitted, snd_nxt/snd_una rewind to retransmit it with the
+        grown payload (see module docstring)."""
+        net = hs.net
+        c = jnp.maximum(jnp.asarray(slot, _I32), 0)
+        mask = jnp.asarray(mask, bool) & (jnp.asarray(slot, _I32) >= 0)
+        row = _row(net.tcb, c)
+        boundary = (row.snd_buf // MSS).astype(_I32)
+        rewind = ((row.snd_buf % MSS) != 0) & (row.snd_nxt > boundary)
+        snd_nxt = jnp.where(rewind, boundary, row.snd_nxt)
+        row = dataclasses.replace(
+            row,
+            snd_buf=row.snd_buf + jnp.asarray(nbytes, _I64),
+            snd_nxt=snd_nxt,
+            snd_una=jnp.minimum(row.snd_una, snd_nxt),
+        )
+        tcb = _write_row(net.tcb, c, row, mask)
+        sockets = net.sockets.add_tx(jnp.where(mask, c, -1), nbytes)
+        hs = dataclasses.replace(
+            hs, net=dataclasses.replace(net, tcb=tcb, sockets=sockets)
+        )
+        return hs, _emit_from_rows([self._kick_row(c, now, now, mask)])
+
+    def close(self, hs, slot, now, mask=True):
+        """Half-close after pending data (tcp.c CLOSED->FIN path): the FIN
+        is sent once everything queued has gone out."""
+        net = hs.net
+        c = jnp.maximum(jnp.asarray(slot, _I32), 0)
+        mask = jnp.asarray(mask, bool) & (jnp.asarray(slot, _I32) >= 0)
+        fp = net.tcb.fin_pending.at[c].set(
+            jnp.where(mask, True, net.tcb.fin_pending[c])
+        )
+        tcb = dataclasses.replace(net.tcb, fin_pending=fp)
+        hs = dataclasses.replace(hs, net=dataclasses.replace(net, tcb=tcb))
+        return hs, _emit_from_rows([self._kick_row(c, now, now, mask)])
+
+    # ------------------------------------------------- segment processing
+    def process_segment(self, stack, hs, slot, pkt: Pkt, ev, key, on_recv):
+        """The vectorized tcp_processPacket (tcp.c:1777): handshake,
+        ACK/reno/RTT, data reassembly, FIN/close transitions, inline tx,
+        ACK generation. Also routes UDP packets to `on_recv` (the stack
+        funnels every demuxed packet here when TCP is installed)."""
+        if hs.net.tcb is None:
+            raise ValueError(
+                "Stack(tcp=...) requires HostNet.create(..., with_tcp=True) "
+                "so the host state carries a TCB table"
+            )
+        net = hs.net
+        now = ev.time
+        unlimited = now < stack.bootstrap_end
+        slot = jnp.asarray(slot, _I32)
+        have = slot >= 0
+        c = jnp.maximum(slot, 0)
+        is_udp = (pkt.proto == PROTO_UDP) & have
+        is_tcp = (pkt.proto == PROTO_TCP) & have
+        row = _row(net.tcb, c)
+        sockets = net.sockets
+
+        f = pkt.flags
+        f_syn = (f & F_SYN) != 0
+        f_ackf = (f & F_ACK) != 0
+        f_fin = (f & F_FIN) != 0
+        f_rst = (f & F_RST) != 0
+        syn_only = is_tcp & f_syn & ~f_ackf
+        synack = is_tcp & f_syn & f_ackf
+        plain_ack = is_tcp & f_ackf & ~f_syn
+
+        # -- passive open: SYN at LISTEN -> child slot (TCPServer/TCPChild,
+        # tcp.c:91-113); SYN at SYN_RCVD = dup -> re-SYN-ACK
+        at_listen = syn_only & (row.state == LISTEN)
+        dup_syn = syn_only & (row.state == SYN_RCVD)
+        free_slot = jnp.argmax(sockets.proto == PROTO_NONE).astype(_I32)
+        do_open = at_listen & (sockets.proto[free_slot] == PROTO_NONE)
+        child = jnp.where(do_open, free_slot, c)
+        child_old = _row(net.tcb, child)
+        child_row = _fresh_row_like(child_old)
+        child_row = dataclasses.replace(
+            child_row,
+            state=jnp.int32(SYN_RCVD),
+            peer_wnd=jnp.maximum(pkt.wnd, 1),
+            timer_live=jnp.asarray(True),
+            timer_gen=child_old.timer_gen + 1,
+            rto_deadline=now + RTO_INIT,
+        )
+        wr = lambda a, v, m: a.at[child].set(jnp.where(m, v, a[child]))
+        sockets = dataclasses.replace(
+            sockets,
+            proto=wr(sockets.proto, PROTO_TCP, do_open),
+            local_port=wr(sockets.local_port, pkt.dst_port, do_open),
+            peer_host=wr(sockets.peer_host, pkt.src_host, do_open),
+            peer_port=wr(sockets.peer_port, pkt.src_port, do_open),
+        )
+
+        # -- handshake completions & RST
+        est_active = synack & (row.state == SYN_SENT)
+        est_passive = plain_ack & (row.state == SYN_RCVD)
+        got_rst = (
+            is_tcp & f_rst & (row.state != LISTEN) & (row.state != CLOSED)
+        )
+        state1 = jnp.where(
+            est_active | est_passive, ESTABLISHED, row.state
+        ).astype(_I32)
+        row = dataclasses.replace(
+            row,
+            state=state1,
+            peer_wnd=jnp.where(
+                est_active | plain_ack, jnp.maximum(pkt.wnd, 1), row.peer_wnd
+            ),
+        )
+        # handshake RTT seeds srtt on the client (SYN ts echoed in SYN-ACK)
+        hs_rtt = jnp.maximum(
+            ((_ts_us(now) - pkt.aux) & 0x7FFFFFFF).astype(_I64) * 1000, 1
+        )
+        sample_hs = est_active & (pkt.aux != 0)
+        row = dataclasses.replace(
+            row,
+            srtt=jnp.where(sample_hs, hs_rtt, row.srtt),
+            rttvar=jnp.where(sample_hs, hs_rtt // 2, row.rttvar),
+            rto=jnp.where(
+                sample_hs,
+                jnp.clip(hs_rtt + 4 * (hs_rtt // 2), RTO_MIN, RTO_MAX),
+                row.rto,
+            ),
+        )
+
+        # -- ACK processing (reno + NewReno recovery + RTT, tcp.c:925-1065,
+        # tcp_cong_reno.c)
+        ack_ok = plain_ack & (row.state >= ESTABLISHED) & (row.state <= LAST_ACK)
+        # the valid ack range is bounded by *ever-sent* data, not snd_nxt:
+        # after a timeout's go-back-N rewind, acks for segments beyond the
+        # rewound snd_nxt are still legitimate and must heal the window
+        ack = jnp.clip(
+            pkt.ack, 0, _n_segs(row.snd_buf) + row.fin_pending.astype(_I32)
+        )
+        advanced = ack_ok & (ack > row.snd_una)
+        n_acked = jnp.where(advanced, ack - row.snd_una, 0)
+        sample = advanced & (pkt.aux != 0)
+        rtt = jnp.maximum(
+            ((_ts_us(now) - pkt.aux) & 0x7FFFFFFF).astype(_I64) * 1000, 1
+        )
+        first = row.srtt == 0
+        srtt_prev = row.srtt
+        srtt = jnp.where(
+            sample, jnp.where(first, rtt, (7 * row.srtt + rtt) // 8), row.srtt
+        )
+        rttvar = jnp.where(
+            sample,
+            jnp.where(
+                first, rtt // 2,
+                (3 * row.rttvar + jnp.abs(srtt_prev - rtt)) // 4,
+            ),
+            row.rttvar,
+        )
+        rto = jnp.where(
+            sample,
+            jnp.clip(srtt + jnp.maximum(4 * rttvar, MILLISECOND), RTO_MIN, RTO_MAX),
+            row.rto,
+        )
+
+        in_rec = row.recover >= 0
+        pure = plain_ack & (pkt.length == 0) & ~f_fin
+        is_dup = (
+            ack_ok & pure & ~advanced
+            & (row.snd_nxt > row.snd_una) & (ack == row.snd_una)
+        )
+        dup_acks = jnp.where(advanced, 0, row.dup_acks + is_dup.astype(_I32))
+        fr = is_dup & (dup_acks == 3) & ~in_rec
+        flight = (row.snd_nxt - row.snd_una).astype(jnp.float32)
+        ssthresh_fr = jnp.maximum(flight / 2, 2.0)
+        exit_rec = advanced & in_rec & (ack >= row.recover)
+        partial_ack = advanced & in_rec & ~exit_rec
+        grow = jnp.where(
+            row.cwnd < row.ssthresh,
+            row.cwnd + n_acked,
+            row.cwnd + n_acked / jnp.maximum(row.cwnd, 1.0),
+        )
+        cwnd = jnp.where(
+            fr, ssthresh_fr + 3,
+            jnp.where(
+                is_dup & in_rec, row.cwnd + 1,
+                jnp.where(
+                    exit_rec, row.ssthresh,
+                    jnp.where(advanced & ~in_rec, grow, row.cwnd),
+                ),
+            ),
+        )
+        cwnd = jnp.minimum(cwnd, CWND_MAX)
+        retx = fr | partial_ack
+        snd_una = jnp.where(advanced, ack, row.snd_una)
+        n_segs = _n_segs(row.snd_buf)
+        fin_acked = row.fin_pending & (snd_una >= n_segs + 1)
+        state2 = jnp.where(
+            (row.state == FIN_WAIT_1) & fin_acked, FIN_WAIT_2,
+            jnp.where(
+                (row.state == CLOSING) & fin_acked, TIME_WAIT,
+                jnp.where(
+                    (row.state == LAST_ACK) & fin_acked, CLOSED, row.state
+                ),
+            ),
+        ).astype(_I32)
+        enter_tw_ack = (row.state == CLOSING) & fin_acked
+        freed_ack = (row.state == LAST_ACK) & fin_acked
+        row = dataclasses.replace(
+            row,
+            state=state2,
+            snd_una=snd_una,
+            snd_nxt=jnp.maximum(row.snd_nxt, snd_una),
+            cwnd=cwnd,
+            ssthresh=jnp.where(fr, ssthresh_fr, row.ssthresh),
+            dup_acks=dup_acks,
+            recover=jnp.where(
+                fr, row.snd_nxt, jnp.where(exit_rec, -1, row.recover)
+            ),
+            srtt=srtt, rttvar=rttvar, rto=rto,
+            rto_deadline=jnp.where(advanced, now + rto, row.rto_deadline),
+            n_retx=row.n_retx + retx.astype(_I32),
+        )
+
+        # -- data / FIN receive: bitmap reassembly + cumulative advance
+        has_seg = (
+            is_tcp & ~f_syn & ((pkt.length > 0) | f_fin)
+            & (row.state >= ESTABLISHED)
+        )
+        off = pkt.seq - row.rcv_nxt
+        in_win = (off >= 0) & (off < RCV_WND)
+        bit = jnp.where(
+            in_win, jnp.uint64(1) << jnp.clip(off, 0, 63).astype(jnp.uint64),
+            jnp.uint64(0),
+        )
+        already = (off < 0) | ((row.ooo & bit) != 0)
+        fresh = has_seg & in_win & ~already
+        refill = (
+            has_seg & ~fresh & (pkt.length > 0)
+            & (pkt.seq == row.partial_seq) & (pkt.length > row.partial_len)
+        )
+        new_bytes = (
+            jnp.where(fresh, pkt.length, 0)
+            + jnp.where(refill, pkt.length - row.partial_len, 0)
+        ).astype(_I32)
+        ooo1 = jnp.where(fresh, row.ooo | bit, row.ooo)
+        adv = jnp.where(fresh, _trailing_ones(ooo1), 0)
+        rcv_nxt = row.rcv_nxt + adv
+        ooo2 = jnp.where(
+            adv >= 64, jnp.uint64(0),
+            ooo1 >> jnp.clip(adv, 0, 63).astype(jnp.uint64),
+        )
+        is_partial = (
+            has_seg & (pkt.length > 0) & (pkt.length < MSS) & (fresh | refill)
+        )
+        clear_partial = (
+            has_seg & (pkt.seq == row.partial_seq) & (pkt.length >= MSS)
+        )
+        rfin = jnp.where(has_seg & f_fin, pkt.seq, row.rfin_seq)
+        consumed_before = (row.rfin_seq >= 0) & (row.rcv_nxt > row.rfin_seq)
+        consumed_after = (rfin >= 0) & (rcv_nxt > rfin)
+        fin_new = consumed_after & ~consumed_before
+        state3 = jnp.where(
+            fin_new & (row.state == ESTABLISHED), CLOSE_WAIT,
+            jnp.where(
+                fin_new & (row.state == FIN_WAIT_1), CLOSING,
+                jnp.where(
+                    fin_new & (row.state == FIN_WAIT_2), TIME_WAIT, row.state
+                ),
+            ),
+        ).astype(_I32)
+        enter_tw = enter_tw_ack | (fin_new & (row.state == FIN_WAIT_2))
+        row = dataclasses.replace(
+            row,
+            state=state3,
+            rcv_nxt=rcv_nxt,
+            ooo=ooo2,
+            rfin_seq=rfin,
+            partial_seq=jnp.where(
+                is_partial, pkt.seq,
+                jnp.where(clear_partial, -1, row.partial_seq),
+            ),
+            partial_len=jnp.where(
+                is_partial, pkt.length,
+                jnp.where(clear_partial, 0, row.partial_len),
+            ),
+        )
+        # auto-close: server-side close when the peer closes
+        do_autoclose = (
+            jnp.asarray(self.auto_close) & (row.state == CLOSE_WAIT)
+            & ~row.fin_pending
+        )
+        row = dataclasses.replace(
+            row, fin_pending=row.fin_pending | do_autoclose
+        )
+        send_ack = has_seg | dup_syn
+
+        # -- retransmit row (fast retransmit / NewReno partial ack)
+        nic_tx = net.nic_tx
+        peer_h = sockets.peer_host[c]
+        peer_p = sockets.peer_port[c]
+        sport = sockets.local_port[c]
+        retx_fin = row.fin_pending & (row.snd_una == n_segs)
+        nic_tx, retx_row = self._seg_row(
+            nic_tx, row, now, peer_h, sport, peer_p, row.snd_una, retx_fin,
+            retx & (row.snd_una < row.snd_nxt), unlimited,
+        )
+
+        # -- inline new-data tx (ACK-clocked)
+        nic_tx, row, data_rows, more = self._tx_segments(
+            nic_tx, row, now, peer_h, sport, peer_p, self.inline_budget,
+            is_tcp & ~do_open, unlimited,
+        )
+        kick = self._kick_row(c, now, nic_tx.free_at, more)
+
+        # -- control/ACK row: SYN-ACK (passive open / dup SYN), the
+        # handshake-completing pure ACK, or a data/dup ACK
+        need_synack = do_open | dup_syn
+        need_ctl = need_synack | est_active | send_ack
+        ctl_flags = jnp.where(need_synack, F_SYN | F_ACK, F_ACK)
+        ctl_ack = jnp.where(need_synack, 0, row.rcv_nxt)
+        # echo the arriving segment's ts for the peer's RTT estimator; the
+        # SYN-ACK echoes the SYN's ts the same way
+        ctl_aux = pkt.aux
+        nic2, _s2, fin_t2 = nic_tx.admit(now, HEADER_TCP, unlimited)
+        nic_tx = jax.tree.map(
+            lambda n, o: jnp.where(need_ctl, n, o), nic2, nic_tx
+        )
+        ctl = dict(
+            dst=pkt.src_host,
+            dt=jnp.where(need_ctl, fin_t2 - now, 0),
+            kind=KIND_PKT_ARRIVE,
+            args=_pkt_args(
+                pkt.dst_port, pkt.src_port, seq=0, ack=ctl_ack, length=0,
+                aux=ctl_aux, flags=ctl_flags,
+            ),
+            mask=need_ctl, local=False,
+        )
+
+        # -- timer row (RTO arm or TIME_WAIT), then slot free / RST reset
+        row, timer_row = self._arm_row(row, c, now, enter_tw)
+        # a passive open must arm the CHILD's RTO timer (SYN-ACK
+        # retransmit; a lost server reply would otherwise hang forever).
+        # The listener's own arm is necessarily idle when a SYN arrives,
+        # so the child shares the row.
+        timer_row = dict(
+            dst=0,
+            dt=jnp.where(do_open, jnp.int64(RTO_INIT), timer_row["dt"]),
+            kind=KIND_TCP_TIMER,
+            args=jnp.where(
+                do_open,
+                _ctl_args(child, child_row.timer_gen, TK_RTO),
+                timer_row["args"],
+            ),
+            mask=timer_row["mask"] | do_open,
+            local=True,
+        )
+        freed = freed_ack | got_rst
+        row = jax.tree.map(
+            lambda fresh_v, cur: jnp.where(freed, fresh_v, cur),
+            dataclasses.replace(
+                _fresh_row_like(row), timer_gen=row.timer_gen + 1
+            ),
+            row,
+        )
+        sockets = dataclasses.replace(
+            sockets, proto=sockets.proto.at[c].set(
+                jnp.where(freed & is_tcp, PROTO_NONE, sockets.proto[c])
+            )
+        )
+
+        # -- write back: main row at c, child row at its slot
+        tcb = _write_row(net.tcb, c, row, is_tcp & ~at_listen)
+        tcb = _write_row(tcb, child, child_row, do_open)
+        # byte accounting: UDP counts arrivals, TCP counts newly-delivered
+        deliver_len = jnp.where(is_tcp, new_bytes, pkt.length)
+        deliver = is_udp | (is_tcp & (new_bytes > 0))
+        sockets = sockets.add_rx(jnp.where(deliver, c, -1), deliver_len)
+        hs = dataclasses.replace(
+            hs,
+            net=dataclasses.replace(
+                net, tcb=tcb, sockets=sockets, nic_tx=nic_tx
+            ),
+        )
+
+        # -- app delivery (once, after all state updates)
+        pkt2 = dataclasses.replace(pkt, length=deliver_len)
+        hs, app_em = on_recv(hs, jnp.where(deliver, slot, -1), pkt2, now, key)
+        ours = _emit_from_rows([ctl, retx_row] + data_rows + [kick, timer_row])
+        return hs, emit_concat(ours, app_em)
+
+    # ------------------------------------------------------ event handlers
+    def _on_tx(self, stack, hs, ev, key):
+        """KIND_TCP_TX: paced/window-limited transmission kick."""
+        net = hs.net
+        now = ev.time
+        c = jnp.maximum(ev.args[T_SLOT], 0)
+        row = _row(net.tcb, c)
+        enabled = net.sockets.proto[c] == PROTO_TCP
+        unlimited = now < stack.bootstrap_end
+        nic_tx, row, rows, more = self._tx_segments(
+            net.nic_tx, row, now,
+            net.sockets.peer_host[c], net.sockets.local_port[c],
+            net.sockets.peer_port[c], self.tx_burst, enabled, unlimited,
+        )
+        rows.append(self._kick_row(c, now, nic_tx.free_at, more))
+        row, timer_row = self._arm_row(
+            row, c, now, jnp.asarray(False)
+        )
+        rows.append(timer_row)
+        tcb = _write_row(net.tcb, c, row, enabled)
+        hs = dataclasses.replace(
+            hs, net=dataclasses.replace(net, tcb=tcb, nic_tx=nic_tx)
+        )
+        return hs, _emit_from_rows(rows)
+
+    def _on_timer(self, stack, hs, ev, key):
+        """KIND_TCP_TIMER: RTO expiry (with lazy reschedule) or TIME_WAIT
+        expiry (tcp.c retransmit timers; CONFIG_TCPCLOSETIMER_DELAY)."""
+        net = hs.net
+        now = ev.time
+        c = jnp.maximum(ev.args[T_SLOT], 0)
+        gen = ev.args[T_GEN]
+        tk = ev.args[T_KIND]
+        row = _row(net.tcb, c)
+        live = (gen == row.timer_gen) & (net.sockets.proto[c] == PROTO_TCP)
+        unlimited = now < stack.bootstrap_end
+
+        # TIME_WAIT expiry: free the slot
+        tw_done = live & (tk == TK_TIMEWAIT) & (row.state == TIME_WAIT)
+
+        rto_ev = live & (tk == TK_RTO)
+        early = rto_ev & (now < row.rto_deadline)
+        fire = rto_ev & ~early
+        outstanding = _outstanding(row)
+        timeout = fire & outstanding
+        # timeout: collapse to loss state (reno timeout hook + go-back-N)
+        flight = (row.snd_nxt - row.snd_una).astype(jnp.float32)
+        row = dataclasses.replace(
+            row,
+            ssthresh=jnp.where(
+                timeout, jnp.maximum(flight / 2, 2.0), row.ssthresh
+            ),
+            cwnd=jnp.where(timeout, 1.0, row.cwnd),
+            dup_acks=jnp.where(timeout, 0, row.dup_acks),
+            recover=jnp.where(timeout, -1, row.recover),
+            rto=jnp.where(
+                timeout, jnp.minimum(row.rto * 2, RTO_MAX), row.rto
+            ),
+            snd_nxt=jnp.where(
+                timeout & (row.state >= ESTABLISHED), row.snd_una, row.snd_nxt
+            ),
+            timer_live=jnp.where(fire & ~outstanding, False, row.timer_live),
+            rto_deadline=jnp.where(
+                timeout,
+                now + jnp.minimum(row.rto * 2, RTO_MAX),
+                row.rto_deadline,
+            ),
+            n_retx=row.n_retx + timeout.astype(_I32),
+        )
+
+        # retransmission: SYN / SYN-ACK / data-or-FIN at snd_una
+        peer_h = net.sockets.peer_host[c]
+        peer_p = net.sockets.peer_port[c]
+        sport = net.sockets.local_port[c]
+        is_syn_rtx = timeout & (row.state == SYN_SENT)
+        is_synack_rtx = timeout & (row.state == SYN_RCVD)
+        is_data_rtx = timeout & (row.state >= ESTABLISHED)
+        n_segs = _n_segs(row.snd_buf)
+        retx_fin = row.fin_pending & (row.snd_una == n_segs)
+        nic_tx, data_row = self._seg_row(
+            net.nic_tx, row, now, peer_h, sport, peer_p, row.snd_una,
+            retx_fin, is_data_rtx, unlimited,
+        )
+        hs_flags = jnp.where(is_syn_rtx, F_SYN, F_SYN | F_ACK)
+        nic2, _s, fin_t = nic_tx.admit(now, HEADER_TCP, unlimited)
+        hs_mask = is_syn_rtx | is_synack_rtx
+        nic_tx = jax.tree.map(
+            lambda n, o: jnp.where(hs_mask, n, o), nic2, nic_tx
+        )
+        hs_row = dict(
+            dst=peer_h, dt=jnp.where(hs_mask, fin_t - now, 0),
+            kind=KIND_PKT_ARRIVE,
+            args=_pkt_args(sport, peer_p, aux=_ts_us(now), flags=hs_flags),
+            mask=hs_mask, local=False,
+        )
+        # re-arm: early -> at deadline (same gen); timeout -> +rto'
+        rearm = early | timeout
+        timer_row = dict(
+            dst=0,
+            dt=jnp.maximum(
+                jnp.where(early, row.rto_deadline - now, row.rto), 1
+            ),
+            kind=KIND_TCP_TIMER,
+            args=_ctl_args(c, row.timer_gen, TK_RTO),
+            mask=rearm, local=True,
+        )
+
+        # free on TIME_WAIT expiry
+        row = jax.tree.map(
+            lambda fresh_v, cur: jnp.where(tw_done, fresh_v, cur),
+            dataclasses.replace(
+                _fresh_row_like(row), timer_gen=row.timer_gen + 1
+            ),
+            row,
+        )
+        sockets = dataclasses.replace(
+            net.sockets,
+            proto=net.sockets.proto.at[c].set(
+                jnp.where(tw_done, PROTO_NONE, net.sockets.proto[c])
+            ),
+        )
+        tcb = _write_row(net.tcb, c, row, live)
+        hs = dataclasses.replace(
+            hs,
+            net=dataclasses.replace(
+                net, tcb=tcb, nic_tx=nic_tx, sockets=sockets
+            ),
+        )
+        return hs, _emit_from_rows([data_row, hs_row, timer_row])
+
+    def make_handlers(self, stack):
+        """[KIND_TCP_TIMER, KIND_TCP_TX] handlers (appended after the
+        stack's arrive/rx pair by Stack.make_handlers)."""
+        return [
+            lambda hs, ev, key: self._on_timer(stack, hs, ev, key),
+            lambda hs, ev, key: self._on_tx(stack, hs, ev, key),
+        ]

@@ -1,0 +1,194 @@
+"""TCP end-to-end tests: handshake, bulk transfer, loss recovery, close.
+
+Mirrors the reference's TCP test matrix — {blocking-style apps} x
+{lossless, lossy} inside an embedded 2-host topology
+(reference: src/test/tcp/CMakeLists.txt:14-60, test_tcp.c) — plus the
+determinism-by-diff discipline of src/test/determinism/.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core.engine import ConstantNetwork, Engine, EngineConfig
+from shadow_tpu.core.events import Events
+from shadow_tpu.core.timebase import MILLISECOND, SECOND, TIME_INVALID
+from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP
+from shadow_tpu.transport import tcp as tcpm
+from shadow_tpu.transport.stack import HostNet, N_PKT_ARGS, SimHost, Stack
+from shadow_tpu.transport.tcp import TCP, emit_concat
+
+KIND_APP = tcpm.N_TCP_KINDS  # client: connect + send (+ maybe close)
+KIND_APP2 = tcpm.N_TCP_KINDS + 1  # client: second send + close
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class App:
+    role: jax.Array  # i32: 0 = client, 1 = server
+    rx: jax.Array  # i64 app-delivered bytes
+    replied: jax.Array  # bool (request/response mode)
+    last_rx: jax.Array  # i64 time of last delivery
+
+
+def build(total=100_000, reply=0, latency=10 * MILLISECOND, bw=1024.0,
+          reliability=1.0, second_send=0, close_after_send=True, seed=7):
+    """Host 0 = client connecting to host 1:80 at t=1ms."""
+    n_hosts = 2
+    tcp = TCP()
+    stack = Stack(tcp=tcp)
+
+    def on_recv(hs, slot, pkt, now, key):
+        app: App = hs.app
+        got = (slot >= 0) & (pkt.length > 0)
+        rx = app.rx + jnp.where(got, pkt.length.astype(jnp.int64), 0)
+        do_reply = (
+            (reply > 0) & (app.role == 1) & (rx >= total) & ~app.replied & got
+        )
+        app = dataclasses.replace(
+            app,
+            rx=rx,
+            replied=app.replied | do_reply,
+            last_rx=jnp.where(got, now, app.last_rx),
+        )
+        hs = dataclasses.replace(hs, app=app)
+        hs, em_s = tcp.send(hs, slot, reply, now, mask=do_reply)
+        hs, em_c = tcp.close(hs, slot, now, mask=do_reply)
+        return hs, emit_concat(em_s, em_c)
+
+    def on_app(hs, ev: Events, key):
+        hs, em1 = tcp.connect(stack, hs, 0, ev.time)
+        hs, em2 = tcp.send(hs, 0, total, ev.time)
+        hs, em3 = tcp.close(hs, 0, ev.time, mask=close_after_send)
+        return hs, emit_concat(em1, em2, em3)
+
+    def on_app2(hs, ev: Events, key):
+        hs, em1 = tcp.send(hs, 0, second_send, ev.time, mask=second_send > 0)
+        hs, em2 = tcp.close(hs, 0, ev.time, mask=second_send > 0)
+        return hs, emit_concat(em1, em2)
+
+    handlers = stack.make_handlers(on_recv) + [on_app, on_app2]
+    cfg = EngineConfig(
+        n_hosts=n_hosts, capacity=256, lookahead=latency, max_emit=8,
+        n_args=N_PKT_ARGS, seed=seed,
+    )
+    eng = Engine(cfg, handlers, ConstantNetwork(latency, reliability))
+
+    net = HostNet.create(n_hosts, 8, bw, bw, with_tcp=True)
+    tab = net.sockets.bind(1, 0, PROTO_TCP, 80)
+    tab = tab.bind(0, 0, PROTO_TCP, 10_000, peer_host=1, peer_port=80)
+    net = dataclasses.replace(net, sockets=tab, tcb=net.tcb.listen(1, 0))
+    z = jnp.zeros((n_hosts,), jnp.int64)
+    hosts = SimHost(
+        net=net,
+        app=App(
+            role=jnp.arange(n_hosts, dtype=jnp.int32),
+            rx=z, replied=jnp.zeros((n_hosts,), bool), last_rx=z,
+        ),
+    )
+
+    ev = Events.empty((2,), n_args=N_PKT_ARGS)
+    times = jnp.asarray(
+        [1 * MILLISECOND, 500 * MILLISECOND if second_send else TIME_INVALID],
+        jnp.int64,
+    )
+    ev = dataclasses.replace(
+        ev,
+        time=times,
+        dst=jnp.zeros((2,), jnp.int32),
+        src=jnp.zeros((2,), jnp.int32),
+        seq=jnp.arange(2, dtype=jnp.int32),
+        kind=jnp.asarray([KIND_APP, KIND_APP2], jnp.int32),
+    )
+    return eng, eng.init_state(hosts, ev)
+
+
+def test_bulk_transfer_lossless_full_close():
+    eng, st = build()
+    st = jax.jit(eng.run)(st, jnp.int64(70 * SECOND))
+    tcb = st.hosts.net.tcb
+    socks = st.hosts.net.sockets
+    # all 100k bytes delivered to the server app, exactly once
+    assert int(st.hosts.app.rx[1]) == 100_000
+    assert int(socks.rx_bytes[1, 1]) == 100_000  # child slot accounting
+    # no losses -> no retransmissions anywhere
+    assert int(tcb.n_retx.sum()) == 0
+    # both endpoints fully closed and their slots freed for reuse
+    # (client passes TIME_WAIT -> CLOSED after the 60s close timer,
+    # CONFIG_TCPCLOSETIMER_DELAY semantics)
+    assert int(tcb.state[0, 0]) == tcpm.CLOSED
+    assert int(tcb.state[1, 1]) == tcpm.CLOSED
+    assert int(socks.proto[0, 0]) == PROTO_NONE
+    assert int(socks.proto[1, 1]) == PROTO_NONE
+    # listener still listening
+    assert int(tcb.state[1, 0]) == tcpm.LISTEN
+    # transfer itself finished quickly (well before the close timer):
+    # 100 KiB at 1 MiB/s is ~100 ms of serialization + slow-start ramp
+    assert int(st.hosts.app.last_rx[1]) < 2 * SECOND
+
+
+def test_bulk_transfer_lossy_recovers_all_bytes():
+    eng, st = build(reliability=0.85, seed=11)
+    st = jax.jit(eng.run)(st, jnp.int64(30 * SECOND))
+    tcb = st.hosts.net.tcb
+    # 15% loss: every byte still arrives, via retransmissions
+    assert int(st.hosts.app.rx[1]) == 100_000
+    assert int(tcb.n_retx[0, 0]) > 0
+    # congestion controller reacted: ssthresh came down from its initial
+    assert float(tcb.ssthresh[0, 0]) < tcpm.INIT_SSTHRESH
+
+
+def test_request_response():
+    eng, st = build(total=100, reply=200, close_after_send=False)
+    st = jax.jit(eng.run)(st, jnp.int64(70 * SECOND))
+    # server got the 100B request, client got the 200B reply
+    assert int(st.hosts.app.rx[1]) == 100
+    assert int(st.hosts.app.rx[0]) == 200
+    # server closed first; auto-close tears the client down too
+    tcb = st.hosts.net.tcb
+    assert int(tcb.state[0, 0]) == tcpm.CLOSED
+    assert int(tcb.state[1, 1]) == tcpm.CLOSED
+
+
+def test_partial_segment_refill():
+    # 100B sent at t=1ms (partial segment), 2000B more at t=500ms: the
+    # partial segment is retransmitted with its grown payload and the app
+    # sees every byte exactly once
+    eng, st = build(total=100, second_send=2000, close_after_send=False)
+    st = jax.jit(eng.run)(st, jnp.int64(30 * SECOND))
+    assert int(st.hosts.app.rx[1]) == 2100
+
+
+def test_heavy_loss_request_response_recovers():
+    """Regression: server-side (passive-open) connections must own an RTO
+    timer — with 30% loss the server's reply/FIN retransmits from the
+    child slot or the exchange hangs forever."""
+    for seed in (1, 2, 4):
+        eng, st = build(
+            total=100, reply=5000, reliability=0.7, close_after_send=False,
+            seed=seed,
+        )
+        st = jax.jit(eng.run)(st, jnp.int64(120 * SECOND))
+        assert int(st.hosts.app.rx[0]) == 5000, f"seed {seed}"
+        assert int(st.hosts.app.rx[1]) == 100, f"seed {seed}"
+        tcb = st.hosts.net.tcb
+        assert int(tcb.state[0, 0]) == tcpm.CLOSED, f"seed {seed}"
+        assert int(tcb.state[1, 1]) == tcpm.CLOSED, f"seed {seed}"
+
+
+def test_rtt_estimator_converges():
+    eng, st = build()
+    st = jax.jit(eng.run)(st, jnp.int64(5 * SECOND))
+    srtt = int(st.hosts.net.tcb.srtt[0, 0])
+    # path RTT is 2*10ms + serialization; srtt must be in that ballpark
+    assert 15 * MILLISECOND < srtt < 200 * MILLISECOND
+
+
+def test_determinism_two_runs_identical():
+    eng, st = build(reliability=0.9, seed=13)
+    run = jax.jit(eng.run)
+    a = run(st, jnp.int64(10 * SECOND))
+    b = run(st, jnp.int64(10 * SECOND))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert jnp.array_equal(x, y)
