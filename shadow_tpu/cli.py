@@ -1,0 +1,155 @@
+"""CLI entry: `python -m shadow_tpu [options] shadow.config.xml`.
+
+Mirrors the reference's command surface (reference:
+src/main/core/support/options.c option table; src/main/core/main.c:735
+main_runShadow): config-file driven, `--test` for the built-in example
+(examples.c), seed / heartbeat-frequency / log-level flags. Flags tied to
+pthread scheduling (--workers, --scheduler-policy) have no TPU meaning and
+are accepted-but-ignored with a note, so existing scripts keep working.
+
+The run loop is the Master round loop (master.c:400-480) at CLI
+granularity: jit-compiled window batches between heartbeat prints, then a
+final summary line with event/window counts and rates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu import __version__
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.examples import example_config
+from shadow_tpu.sim import build_simulation
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native discrete-event network simulator",
+    )
+    p.add_argument("config", nargs="?", help="shadow.config.xml path")
+    p.add_argument("--test", action="store_true",
+                   help="run the built-in example config (examples.c)")
+    p.add_argument("--seed", "-s", type=int, default=1,
+                   help="random seed (options.c --seed)")
+    p.add_argument("--stoptime", type=float, default=None,
+                   help="override the config's stoptime (seconds)")
+    p.add_argument("--bootstrap-end", type=float, default=None,
+                   help="override bootstraptime (unlimited-bw phase end)")
+    p.add_argument("--heartbeat-frequency", type=float, default=60.0,
+                   help="sim seconds between heartbeat lines "
+                        "(options.c --heartbeat-frequency)")
+    p.add_argument("--sockets", type=int, default=8,
+                   help="socket slots per host")
+    p.add_argument("--capacity", type=int, default=256,
+                   help="event-queue slots per host")
+    p.add_argument("--log-level", "-l", default="message",
+                   choices=["error", "critical", "warning", "message",
+                            "info", "debug"])
+    p.add_argument("--workers", "-w", type=int, default=None,
+                   help="ignored (pthread-era flag; kept for compatibility)")
+    p.add_argument("--scheduler-policy", "-p", default=None,
+                   help="ignored (pthread-era flag; kept for compatibility)")
+    p.add_argument("--show-build-info", action="store_true")
+    return p
+
+
+def _heartbeat_lines(st, names, sim_now_s: float) -> list[str]:
+    """[shadow-heartbeat] [node] per-host CSV — the tracker's format
+    spirit (tracker.c:433-479 'name,rx,tx,...')."""
+    socks = st.hosts.net.sockets
+    rx = jax.device_get(socks.rx_bytes.sum(axis=1))
+    tx = jax.device_get(socks.tx_bytes.sum(axis=1))
+    ev = jax.device_get(st.stats.n_executed)
+    out = []
+    for i, name in enumerate(names):
+        out.append(
+            f"[shadow-heartbeat] [node] {sim_now_s:.0f},{name},"
+            f"{int(rx[i])},{int(tx[i])},{int(ev[i])}"
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.show_build_info:
+        print(f"shadow_tpu {__version__} (jax {jax.__version__}, "
+              f"backend {jax.default_backend()})")
+        return 0
+    if args.workers is not None or args.scheduler_policy is not None:
+        print("note: --workers/--scheduler-policy are pthread-era flags; "
+              "parallelism is the device mesh here", file=sys.stderr)
+
+    if args.test:
+        cfg = parse_config(example_config())
+    elif args.config:
+        cfg = parse_config(args.config)
+    else:
+        print("error: a config file (or --test) is required", file=sys.stderr)
+        return 2
+    if args.stoptime is not None:
+        cfg = dataclasses.replace(cfg, stoptime=args.stoptime)
+    if args.bootstrap_end is not None:
+        cfg = dataclasses.replace(cfg, bootstraptime=args.bootstrap_end)
+
+    t0 = time.perf_counter()
+    sim = build_simulation(
+        cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity
+    )
+    n_hosts = len(sim.names)
+    print(f"shadow_tpu {__version__}: {n_hosts} hosts, "
+          f"{sim.topo.n_vertices} topology vertices, "
+          f"stoptime {cfg.stoptime:.0f}s, backend {jax.default_backend()}",
+          file=sys.stderr)
+
+    run = jax.jit(sim.engine.run)
+    st = sim.state0
+    stop_s = cfg.stoptime
+    # hb <= 0 disables heartbeats: one straight run to stoptime
+    hb = args.heartbeat_frequency if args.heartbeat_frequency > 0 else stop_s
+    sim_s = 0.0
+    t1 = time.perf_counter()
+    while sim_s < stop_s:
+        nxt = min(sim_s + hb, stop_s)
+        st = run(st, jnp.int64(int(nxt * SECOND)))
+        st.now.block_until_ready()
+        sim_s = nxt
+        if args.heartbeat_frequency > 0:
+            for line in _heartbeat_lines(st, sim.names, sim_s):
+                print(line)
+    wall = time.perf_counter() - t1
+
+    stats = st.stats
+    executed = int(jax.device_get(stats.n_executed.sum()))
+    summary = {
+        "hosts": n_hosts,
+        "sim_seconds": stop_s,
+        "wall_seconds": round(wall, 3),
+        "build_seconds": round(t1 - t0, 3),
+        "events": executed,
+        "windows": int(jax.device_get(stats.n_windows)),
+        "events_per_sec": round(executed / max(wall, 1e-9), 1),
+        "sim_s_per_wall_s": round(stop_s / max(wall, 1e-9), 3),
+        "net_dropped": int(jax.device_get(stats.n_net_dropped.sum())),
+        "queue_drops": int(jax.device_get(st.queues.drops.sum())),
+        "rx_bytes": int(
+            jax.device_get(st.hosts.net.sockets.rx_bytes.sum())
+        ),
+        "tx_bytes": int(
+            jax.device_get(st.hosts.net.sockets.tx_bytes.sum())
+        ),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

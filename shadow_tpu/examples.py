@@ -1,0 +1,42 @@
+"""Built-in example configuration (the reference's --test content,
+src/main/core/support/examples.c:1-86: a minimal embedded config so
+`shadow --test` runs without any files on disk)."""
+
+EXAMPLE_TOPOLOGY = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d6" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d5" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d4" />
+  <key attr.name="countrycode" attr.type="string" for="node" id="d3" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d1" />
+  <key attr.name="ip" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d0">0.0.0.0</data>
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+      <data key="d3">US</data>
+      <data key="d4">0.0</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d5">50.0</data>
+      <data key="d6">0.0</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+
+def example_config() -> str:
+    """A 2-host TGen echo over a 50ms single-PoI topology — the same shape
+    as the shipped example (resource/examples/shadow.config.xml)."""
+    return f"""<shadow stoptime="120">
+  <topology><![CDATA[{EXAMPLE_TOPOLOGY}]]></topology>
+  <plugin id="tgen" path="tgen"/>
+  <host id="server">
+    <process plugin="tgen" starttime="1" arguments="server port=8888"/>
+  </host>
+  <host id="client">
+    <process plugin="tgen" starttime="2"
+      arguments="peers=server:8888 sendsize=64KiB recvsize=1MiB count=3 pause=1,2,3"/>
+  </host>
+</shadow>"""

@@ -1,0 +1,152 @@
+"""PHOLD over the real UDP stack (the config-driven variant).
+
+The reference's PHOLD plugin sends 1-byte UDP datagrams to weighted-random
+peers on port 8998: at startup each peer generates `load` messages, and
+every received message triggers one new message to a weighted-random
+target (reference: src/test/phold/test_phold.c:36-52 `_phold_chooseTarget`
+weights, PHOLD_LISTEN_PORT 8998, config
+src/test/phold/phold.test.shadow.config.xml arguments
+"basename=peer quantity=10 load=25 weightsfilepath=weights.txt").
+
+Unlike models/phold.py (the raw-engine microbenchmark), this model runs
+each message through the full pipeline: socket -> tx NIC -> topology
+latency/reliability -> CoDel -> rx NIC -> socket demux, so it doubles as a
+stack stress test at config-selected scale.
+
+Arguments: `basename=peer quantity=N load=K [weightsfilepath=weights.txt]`
+(weights file: one float per line, weight of peer i; uniform if absent —
+matching the plugin's behavior when weights are equal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config import parse_kv_arguments, resolve_path
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.events import Events
+from shadow_tpu.host.sockets import PROTO_UDP
+from shadow_tpu.transport.stack import N_PKT_ARGS
+
+PHOLD_PORT = 8998  # test_phold.c PHOLD_LISTEN_PORT
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PholdNetState:
+    gid: jax.Array  # i32 own global id (vmap lane recovery)
+    n_sent: jax.Array  # i64
+    n_recv: jax.Array  # i64
+
+
+class PholdNetModel:
+    name = "phold"
+    needs_tcp = False
+    n_kinds = 1  # KIND_LOAD: emit one startup message, chain the next
+
+    def __init__(self):
+        self._stack = None
+        self._kind_load = None
+
+    def app_rows(self) -> int:
+        return 1  # one relayed message per receive
+
+    def handler_rows(self) -> int:
+        return 2  # startup message + chain event
+
+    def build(self, b):
+        n = b.n_hosts
+        load = np.zeros((n,), np.int32)
+        member = np.zeros((n,), bool)
+        weights = None
+        for h in b.hosts:
+            for proc in h.spec.processes:
+                kv = parse_kv_arguments(proc.arguments)
+                member[h.gid] = True
+                load[h.gid] = int(kv.get("load", 1))
+                wf = kv.get("weightsfilepath", "")
+                if wf and weights is None:
+                    path = resolve_path(wf, b.cfg.base_dir)
+                    if os.path.exists(path):
+                        with open(path) as f:
+                            weights = np.asarray(
+                                [float(x) for x in f.read().split() if x],
+                                np.float64,
+                            )
+                b.add_start_event(h.gid, proc.starttime, 0, [load[h.gid]])
+                b.sockets = b.sockets.bind(h.gid, 0, PROTO_UDP, PHOLD_PORT)
+
+        targets = np.nonzero(member)[0].astype(np.int32)
+        if weights is None or len(weights) != len(targets):
+            weights = np.ones((len(targets),), np.float64)
+        cdf = np.cumsum(weights / weights.sum())
+
+        self._targets = jnp.asarray(targets)
+        self._cdf = jnp.asarray(cdf, jnp.float32)
+
+        state = PholdNetState(
+            gid=jnp.arange(n, dtype=_I32),
+            n_sent=jnp.zeros((n,), _I64),
+            n_recv=jnp.zeros((n,), _I64),
+        )
+        return state, self._make_handlers, self._on_recv
+
+    def _pick_target(self, key):
+        """Weighted choice by inverse-CDF (the plugin walks its weight
+        array the same way, test_phold.c _phold_chooseTarget)."""
+        u = jax.random.uniform(key)
+        idx = jnp.searchsorted(self._cdf, u)
+        return self._targets[jnp.minimum(idx, len(self._targets) - 1)]
+
+    def _send_one(self, hs, now, key, mask):
+        stack = self._stack
+        target = self._pick_target(key)
+        hs, em = stack.send_udp(
+            hs, now, 0, target, PHOLD_PORT, 1, mask=mask
+        )
+        app = hs.app
+        app = dataclasses.replace(
+            app, n_sent=app.n_sent + jnp.where(mask, 1, 0)
+        )
+        return dataclasses.replace(hs, app=app), em
+
+    def _make_handlers(self, stack, kind_base):
+        self._stack = stack
+        self._kind_load = kind_base
+
+        def on_load(hs, ev: Events, key):
+            # emit one of the `load` startup messages, then chain the next
+            # (keeps max_emit at 2 instead of `load`)
+            remaining = ev.args[0]
+            ok = remaining > 0
+            hs, em_msg = self._send_one(hs, ev.time, key, ok)
+            args = jnp.zeros((N_PKT_ARGS,), _I32).at[0].set(remaining - 1)
+            em_next = Emit(
+                dst=jnp.zeros((1,), _I32),
+                dt=jnp.ones((1,), _I64),
+                kind=jnp.full((1,), self._kind_load, _I32),
+                args=args[None, :],
+                mask=jnp.asarray(remaining > 1).reshape(1),
+                local=jnp.ones((1,), bool),
+            )
+            return hs, jax.tree.map(
+                lambda a, b_: jnp.concatenate([a, b_]), em_msg, em_next
+            )
+
+        return [on_load]
+
+    def _on_recv(self, hs, slot, pkt, now, key):
+        got = slot >= 0
+        app = hs.app
+        app = dataclasses.replace(
+            app, n_recv=app.n_recv + jnp.where(got, 1, 0)
+        )
+        hs = dataclasses.replace(hs, app=app)
+        return self._send_one(hs, now, key, got)

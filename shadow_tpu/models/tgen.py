@@ -1,0 +1,347 @@
+"""TGen-like traffic generator, compiled into the device step.
+
+The reference drives its example/benchmark configs with TGen — a traffic
+generator whose behavior is an action graph (GraphML) of
+start -> stream -> end -> pause -> ... nodes (reference:
+resource/examples/shadow.config.xml runs plugin tgen with
+tgen.client.graphml.xml / tgen.server.graphml.xml; the client graph's
+stream node carries sendsize/recvsize, the end node a stream count, the
+pause node a comma list of wait seconds; the server graph is a start node
+with a serverport).
+
+Model semantics (the jitted app tier of SURVEY.md §7 step 6a):
+
+- A *server* host binds a TCP listener on `serverport` at process start.
+- A *client* host runs `count` sequential streams against its peer list
+  (round-robin): each stream opens a fresh connection (fresh ephemeral
+  port), sends `sendsize` bytes, then half-closes. The server replies to
+  the stream EOF with `recvsize` bytes (looked up from the client's own
+  static config table — the real tgen transmits the size inside its
+  command header; metadata-only packets can't carry app bytes, so the
+  server reads the global config table by the client's gid instead) and
+  closes. The client counts reply bytes; on completion it waits `pause`
+  (cycling the choices) and starts the next stream.
+
+Deliberate deviations (documented for the parity check):
+- a zero sendsize is sent as 1 byte (the command-header stand-in);
+- one concurrent outbound stream per host (tgen graphs can fan out);
+- the pause choice cycles round-robin instead of uniformly at random.
+
+Arguments accepted per <process>: a path to a tgen GraphML file (like the
+reference's configs) or an inline 'k=v' string: `server port=8888` /
+`peers=server:8888,b:80 sendsize=1MiB recvsize=1MiB count=10 pause=1,2,3
+time=0`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config import parse_kv_arguments, parse_size, resolve_path
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.events import Events
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.host.sockets import EPHEMERAL_BASE, PROTO_TCP
+from shadow_tpu.transport.stack import F_FIN, N_PKT_ARGS
+from shadow_tpu.transport.tcp import emit_concat
+
+try:
+    import networkx as nx
+except ImportError:  # pragma: no cover
+    nx = None
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+@dataclasses.dataclass
+class TGenProfile:
+    """One host's parsed tgen behavior."""
+
+    server_port: int = -1  # >=0: listen
+    peers: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+    sendsize: int = 0
+    recvsize: int = 0
+    count: int = 1
+    pause_s: list[float] = dataclasses.field(default_factory=lambda: [1.0])
+    start_delay_s: float = 0.0
+
+
+def parse_tgen_graphml(text: str) -> TGenProfile:
+    """Subset of the tgen action-graph format (see module docstring)."""
+    if nx is None:  # pragma: no cover
+        raise RuntimeError("networkx unavailable")
+    g = nx.parse_graphml(text)
+    prof = TGenProfile()
+    for nid, a in g.nodes(data=True):
+        nid_l = str(nid).lower()
+        if nid_l.startswith("start"):
+            if "serverport" in a:
+                prof.server_port = int(a["serverport"])
+            if "peers" in a:
+                prof.peers = [
+                    (p.rsplit(":", 1)[0], int(p.rsplit(":", 1)[1]))
+                    for p in str(a["peers"]).split(",") if p.strip()
+                ]
+            if "time" in a:
+                prof.start_delay_s = float(str(a["time"]).split(",")[0])
+        elif nid_l.startswith("stream") or nid_l.startswith("transfer"):
+            if "sendsize" in a:
+                prof.sendsize = parse_size(a["sendsize"])
+            if "recvsize" in a:
+                prof.recvsize = parse_size(a["recvsize"])
+            # legacy <transfer> node: type get/put + filesize
+            if "filesize" in a:
+                size = parse_size(a["filesize"])
+                if str(a.get("type", "get")).lower() == "get":
+                    prof.recvsize = size
+                else:
+                    prof.sendsize = size
+        elif nid_l.startswith("pause"):
+            if "time" in a:
+                prof.pause_s = [
+                    float(t) for t in str(a["time"]).split(",") if t.strip()
+                ]
+        elif nid_l.startswith("end"):
+            if "count" in a:
+                prof.count = int(a["count"])
+    return prof
+
+
+def parse_arguments(args: str, base_dir: str) -> TGenProfile:
+    args = args.strip()
+    if args and " " not in args and (
+        args.endswith(".xml") or args.endswith(".graphml")
+    ):
+        path = resolve_path(args, base_dir)
+        if os.path.exists(path):
+            with open(path) as f:
+                return parse_tgen_graphml(f.read())
+        raise FileNotFoundError(f"tgen graph file not found: {args!r}")
+    kv = parse_kv_arguments(args)
+    prof = TGenProfile()
+    if "server" in kv or "serverport" in kv:
+        prof.server_port = int(kv.get("serverport") or kv.get("port", 8888))
+    if "peers" in kv:
+        prof.peers = [
+            (p.rsplit(":", 1)[0], int(p.rsplit(":", 1)[1]))
+            for p in kv["peers"].split(",") if p.strip()
+        ]
+    if "sendsize" in kv:
+        prof.sendsize = parse_size(kv["sendsize"])
+    if "recvsize" in kv:
+        prof.recvsize = parse_size(kv["recvsize"])
+    if "count" in kv:
+        prof.count = int(kv["count"])
+    if "pause" in kv:
+        prof.pause_s = [float(t) for t in kv["pause"].split(",") if t.strip()]
+    if "time" in kv:
+        prof.start_delay_s = float(kv["time"])
+    return prof
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TGenState:
+    """Dynamic per-host app state ([H] at rest; scalar lanes under vmap).
+
+    `gid` is the host's own global id — static, but carried in the state
+    pytree so vmapped handlers can index global config tables for their
+    own lane (the engine batches host state; closures aren't sliced).
+    """
+
+    gid: jax.Array  # i32 (static iota)
+    streams_started: jax.Array  # i32
+    streams_done: jax.Array  # i32
+    conn_rx: jax.Array  # i64 bytes received on the current outbound stream
+    t_last_done: jax.Array  # i64 sim time the last stream completed
+
+
+class TGenModel:
+    """AppModel implementation (see shadow_tpu.sim.AppModel)."""
+
+    name = "tgen"
+    needs_tcp = True
+    n_kinds = 1  # KIND_STREAM: start/continue the client stream loop
+
+    def __init__(self):
+        self._stack = None
+        self._kind_stream = None
+
+    def app_rows(self) -> int:
+        return 3  # server: reply send + close; client: next-stream event
+
+    def handler_rows(self) -> int:
+        return 4  # connect(2) + send(1) + close(1)
+
+    def build(self, b):
+        n = b.n_hosts
+        server_port = np.full((n,), -1, np.int32)
+        sendsize = np.zeros((n,), np.int64)
+        recvsize = np.zeros((n,), np.int64)
+        count = np.zeros((n,), np.int32)
+        profiles: list[TGenProfile | None] = [None] * n
+
+        for h in b.hosts:
+            if len(h.spec.processes) > 1:
+                # one tgen process per host for now: profiles are per-host
+                # arrays and clients own a single stream slot, so a second
+                # process would silently clobber the first mid-flight
+                raise ValueError(
+                    f"host {h.name!r} declares {len(h.spec.processes)} tgen "
+                    "processes; the jitted tgen model supports one per host"
+                )
+            for proc in h.spec.processes:
+                prof = parse_arguments(proc.arguments, b.cfg.base_dir)
+                profiles[h.gid] = prof
+                server_port[h.gid] = prof.server_port
+                sendsize[h.gid] = max(prof.sendsize, 1)
+                recvsize[h.gid] = prof.recvsize
+                count[h.gid] = prof.count if prof.peers else 0
+                b.add_start_event(
+                    h.gid, proc.starttime + prof.start_delay_s, 0
+                )
+
+        max_peers = max((len(p.peers) for p in profiles if p), default=0) or 1
+        peer_gid = np.zeros((n, max_peers), np.int32)
+        peer_port = np.zeros((n, max_peers), np.int32)
+        n_peers = np.zeros((n,), np.int32)
+        max_pause = max((len(p.pause_s) for p in profiles if p), default=0) or 1
+        pause_ns = np.full((n, max_pause), SECOND, np.int64)
+        n_pause = np.ones((n,), np.int32)
+        for h in b.hosts:
+            prof = profiles[h.gid]
+            if prof is None:
+                continue
+            for j, (pname, pport) in enumerate(prof.peers):
+                peer_gid[h.gid, j] = b.resolve_gid(pname)
+                peer_port[h.gid, j] = pport
+            n_peers[h.gid] = len(prof.peers)
+            for j, t in enumerate(prof.pause_s):
+                pause_ns[h.gid, j] = int(t * SECOND)
+            n_pause[h.gid] = max(len(prof.pause_s), 1)
+
+        # static listener binds (slot 0) — the reference binds listeners
+        # during process start (host.c:773-900)
+        for gid in range(n):
+            if server_port[gid] >= 0:
+                b.sockets = b.sockets.bind(
+                    gid, 0, PROTO_TCP, int(server_port[gid])
+                )
+                b.tcb = b.tcb.listen(gid, 0)
+
+        cs = b.n_sockets - 1  # dedicated client-stream slot (children
+        # allocate first-free from 0, so the ends never collide)
+        self._g = dict(
+            peer_gid=jnp.asarray(peer_gid),
+            peer_port=jnp.asarray(peer_port),
+            n_peers=jnp.asarray(n_peers),
+            sendsize=jnp.asarray(sendsize),
+            recvsize=jnp.asarray(recvsize),
+            count=jnp.asarray(count),
+            pause_ns=jnp.asarray(pause_ns),
+            n_pause=jnp.asarray(n_pause),
+        )
+        self._cs = cs
+
+        z32 = jnp.zeros((n,), _I32)
+        state = TGenState(
+            gid=jnp.arange(n, dtype=_I32),
+            streams_started=z32,
+            streams_done=z32,
+            conn_rx=jnp.zeros((n,), _I64),
+            t_last_done=jnp.zeros((n,), _I64),
+        )
+        return state, self._make_handlers, self._on_recv
+
+    # ---------------------------------------------------------- handlers
+    def _make_handlers(self, stack, kind_base):
+        self._stack = stack
+        self._kind_stream = kind_base
+        return [self._on_stream]
+
+    def _on_stream(self, hs, ev: Events, key):
+        """KIND_STREAM: open the next outbound stream (clients only)."""
+        stack, tcp, g, cs = self._stack, self._stack.tcp, self._g, self._cs
+        app: TGenState = hs.app
+        me = app.gid
+        ok = (g["n_peers"][me] > 0) & (app.streams_started < g["count"][me])
+        idx = app.streams_started
+        pidx = idx % jnp.maximum(g["n_peers"][me], 1)
+        peer = g["peer_gid"][me, pidx]
+        pport = g["peer_port"][me, pidx]
+        sport = EPHEMERAL_BASE + idx
+
+        # rebind the client slot for a fresh connection (fresh ephemeral
+        # port per stream = TIME_WAIT safety; host.c:1058-1110 random-port
+        # allocation becomes a deterministic per-stream port)
+        sk = hs.net.sockets
+        w = lambda a, v: a.at[cs].set(jnp.where(ok, v, a[cs]))
+        sk = dataclasses.replace(
+            sk,
+            proto=w(sk.proto, PROTO_TCP),
+            local_port=w(sk.local_port, sport),
+            peer_host=w(sk.peer_host, peer),
+            peer_port=w(sk.peer_port, pport),
+        )
+        app = dataclasses.replace(
+            app,
+            streams_started=app.streams_started + ok.astype(_I32),
+            conn_rx=jnp.where(ok, 0, app.conn_rx),
+        )
+        hs = dataclasses.replace(
+            hs, app=app, net=dataclasses.replace(hs.net, sockets=sk)
+        )
+        hs, em1 = tcp.connect(stack, hs, cs, ev.time, mask=ok)
+        hs, em2 = tcp.send(hs, cs, g["sendsize"][me], ev.time, mask=ok)
+        hs, em3 = tcp.close(hs, cs, ev.time, mask=ok)
+        return hs, emit_concat(em1, em2, em3)
+
+    def _on_recv(self, hs, slot, pkt, now, key):
+        """Demuxed delivery: client reply accounting + server EOF reply."""
+        tcp, g, cs = self._stack.tcp, self._g, self._cs
+        app: TGenState = hs.app
+        me = app.gid
+        got = slot >= 0
+        eof = got & ((pkt.flags & F_FIN) != 0)
+        is_client_sock = got & (slot == cs)
+
+        # ---- client: count reply bytes, detect stream completion
+        before = app.conn_rx
+        after = before + jnp.where(is_client_sock, pkt.length.astype(_I64), 0)
+        need = g["recvsize"][me]
+        bytes_done = is_client_sock & (before < need) & (after >= need)
+        eof_done = is_client_sock & eof & (after >= need)
+        newly = (bytes_done | eof_done) & (
+            app.streams_done < app.streams_started
+        )
+        done_idx = app.streams_done
+        app = dataclasses.replace(
+            app,
+            conn_rx=after,
+            streams_done=app.streams_done + newly.astype(_I32),
+            t_last_done=jnp.where(newly, now, app.t_last_done),
+        )
+        hs = dataclasses.replace(hs, app=app)
+
+        # next stream after the cycling pause choice
+        more = newly & (app.streams_done < g["count"][me])
+        pause = g["pause_ns"][me, done_idx % jnp.maximum(g["n_pause"][me], 1)]
+        em_next = Emit.single(
+            dst=0, dt=pause, kind=self._kind_stream, mask=more, local=True,
+            n_args=N_PKT_ARGS,
+        )
+
+        # ---- server: reply to stream EOF (size from the client's static
+        # config), then close
+        do_reply = eof & ~is_client_sock
+        reply_sz = g["recvsize"][pkt.src_host]
+        hs, em_s = tcp.send(hs, slot, reply_sz, now,
+                            mask=do_reply & (reply_sz > 0))
+        hs, em_c = tcp.close(hs, slot, now, mask=do_reply)
+        return hs, emit_concat(em_s, em_c, em_next)

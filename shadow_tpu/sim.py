@@ -1,0 +1,295 @@
+"""Simulation assembly: parsed config -> engine + device state + run loop.
+
+This is the TPU-era Master/Slave bootstrap (reference:
+src/main/core/master.c:271-448 `_master_registerPlugins/_master_registerHosts`
+-> slave_addNewVirtualHost -> host_new/host_setup -> scheduler_addHost):
+load the topology, expand and attach hosts, register DNS names, size the
+NICs, let the app model bind its sockets and schedule its process start
+events, then compile everything into one Engine whose handler table is
+[stack pipeline | TCP machinery | app kinds].
+
+Where the reference walks XML into heap objects and pthread queues, this
+builder walks the same config into struct-of-arrays device state; where
+the reference's hosts are partitioned across worker threads by random
+shuffle (scheduler.c:440-534), hosts here are block-partitioned across the
+device mesh axis by dense gid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config import (
+    HostInstance,
+    ShadowConfig,
+    expand_hosts,
+    resolve_path,
+)
+from shadow_tpu.core.engine import Engine, EngineConfig
+from shadow_tpu.core.events import Events
+from shadow_tpu.core.timebase import MILLISECOND, SECOND, TIME_INVALID
+from shadow_tpu.net.dns import DNS
+from shadow_tpu.net.topology import Topology
+from shadow_tpu.transport.stack import N_PKT_ARGS, SimHost, Stack, HostNet
+from shadow_tpu.transport.tcp import TCP
+
+DEFAULT_BANDWIDTH_KIB = 10240  # when neither host attr nor vertex attr set
+
+
+@dataclasses.dataclass
+class SimBuild:
+    """Mutable build context handed to the app model.
+
+    The app reads per-host process specs/arguments, resolves peer names
+    through `dns`, binds listen sockets into `sockets`/`tcb`, and appends
+    process start events (starttime semantics of the <process> element).
+    """
+
+    cfg: ShadowConfig
+    hosts: list[HostInstance]
+    dns: DNS
+    topo: Topology
+    n_sockets: int
+    sockets: Any  # SocketTable [H, S]
+    tcb: Any  # transport.tcp.TCB [H, S] or None
+    start_events: list[tuple[int, int, int, list[int]]] = dataclasses.field(
+        default_factory=list
+    )  # (time_ns, gid, kind_rel, args words)
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.hosts)
+
+    def resolve_gid(self, name: str) -> int:
+        addr = self.dns.resolve_name(name)
+        if addr is None:
+            raise ValueError(f"unknown hostname in config: {name!r}")
+        return addr.host_id
+
+    def add_start_event(self, gid: int, time_s: float, kind_rel: int,
+                        args: list[int] | None = None) -> None:
+        self.start_events.append(
+            (int(time_s * SECOND), gid, kind_rel, list(args or []))
+        )
+
+
+class AppModel(Protocol):
+    """A jitted application compiled into the device step (the fast tier
+    of SURVEY.md §7 step 6: the analog of a plugin binary is a handler
+    table + static per-host config arrays)."""
+
+    name: str
+    needs_tcp: bool
+    n_kinds: int
+
+    def app_rows(self) -> int:
+        """Emit rows the on_recv callback returns (for max_emit sizing)."""
+        ...
+
+    def handler_rows(self) -> int:
+        """Max Emit rows any of the app's own kind handlers returns."""
+        ...
+
+    def build(self, b: SimBuild) -> tuple[Any, Callable, Callable | None]:
+        """-> (app_state [H,...], make_handlers(stack, kind_base) ->
+        [handlers], on_recv or None)."""
+        ...
+
+
+@dataclasses.dataclass
+class Simulation:
+    """A built, runnable simulation."""
+
+    engine: Engine
+    state0: Any  # EngineState
+    stop_ns: int
+    dns: DNS
+    topo: Topology
+    names: list[str]
+    app: Any  # the AppModel instance
+    stack: Stack
+
+    _jit_run: Any = None
+    _jit_step: Any = None
+
+    def run(self, stop_ns: int | None = None, state=None):
+        """Jit-run to the stop time; returns the final EngineState.
+
+        The jitted callables are cached on the instance so repeated calls
+        (the CLI's heartbeat loop, checkpoint-interval stepping) reuse one
+        compiled executable instead of retracing."""
+        if self._jit_run is None:
+            object.__setattr__(self, "_jit_run", jax.jit(self.engine.run))
+        st = state if state is not None else self.state0
+        stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
+        return self._jit_run(st, stop)
+
+    def step_window(self, state, stop_ns: int | None = None):
+        if self._jit_step is None:
+            object.__setattr__(
+                self, "_jit_step", jax.jit(self.engine.step_window)
+            )
+        stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
+        return self._jit_step(state, stop)
+
+
+def _plugin_key(cfg: ShadowConfig, plugin_id: str) -> str:
+    """Registry key for a plugin: its id, falling back to path basename
+    substring matching (the reference identifies plugins purely by id but
+    test configs name them after their .so, e.g. 'shadow-plugin-test-phold')."""
+    spec = cfg.plugin_by_id(plugin_id)
+    names = [plugin_id] + ([spec.path.rsplit("/", 1)[-1]] if spec else [])
+    return " ".join(names).lower()
+
+
+def resolve_app_model(cfg: ShadowConfig, registry: dict[str, Callable]):
+    """Pick the single app model implied by the config's plugins.
+
+    v1 constraint: one model per simulation (multi-model handler-table
+    fusion is future work); every process's plugin must map to it.
+    """
+    found: dict[str, Callable] = {}
+    for h in cfg.hosts:
+        for p in h.processes:
+            key = _plugin_key(cfg, p.plugin)
+            for regname, factory in registry.items():
+                if regname in key:
+                    found[regname] = factory
+                    break
+            else:
+                raise ValueError(
+                    f"no app model registered for plugin {p.plugin!r} "
+                    f"(known: {sorted(registry)})"
+                )
+    if len(found) != 1:
+        raise ValueError(
+            f"config mixes app models {sorted(found)}; v1 supports one"
+        )
+    return next(iter(found.values()))()
+
+
+def build_simulation(
+    cfg: ShadowConfig,
+    registry: dict[str, Callable] | None = None,
+    *,
+    seed: int = 0,
+    n_sockets: int = 8,
+    capacity: int = 256,
+    app_model: Any = None,
+) -> Simulation:
+    """Config -> Simulation (single shard; mesh sharding via parallel.mesh)."""
+    if registry is None:
+        registry = default_registry()
+    topo = Topology.from_graphml(cfg.topology_source())
+    hosts = expand_hosts(cfg)
+    n_hosts = len(hosts)
+
+    # -- attachment + DNS (master.c:307-345 registerHosts -> topology_attach,
+    # dns_register)
+    dns = DNS()
+    host_vertex = []
+    for h in hosts:
+        s = h.spec
+        v = topo.attach(
+            ip_hint=s.iphint, citycode_hint=s.citycodehint,
+            countrycode_hint=s.countrycodehint, geocode_hint=s.geocodehint,
+            type_hint=s.typehint,
+        )
+        host_vertex.append(v)
+        dns.register(h.gid, h.name, s.iphint or None)
+
+    # -- NIC sizing: host attr overrides vertex attr (docs/3.1 host element)
+    bw_up = np.zeros((n_hosts,), np.float64)
+    bw_down = np.zeros((n_hosts,), np.float64)
+    for h, v in zip(hosts, host_vertex):
+        vx = topo.vertices[v]
+        bw_up[h.gid] = h.spec.bandwidthup or vx.bandwidth_up_kib or DEFAULT_BANDWIDTH_KIB
+        bw_down[h.gid] = (
+            h.spec.bandwidthdown or vx.bandwidth_down_kib or DEFAULT_BANDWIDTH_KIB
+        )
+
+    model = app_model if app_model is not None else resolve_app_model(cfg, registry)
+    net = HostNet.create(
+        n_hosts, n_sockets, jnp.asarray(bw_up), jnp.asarray(bw_down),
+        with_tcp=model.needs_tcp,
+    )
+
+    b = SimBuild(
+        cfg=cfg, hosts=hosts, dns=dns, topo=topo, n_sockets=n_sockets,
+        sockets=net.sockets, tcb=net.tcb,
+    )
+    app_state, make_handlers, on_recv = model.build(b)
+    net = dataclasses.replace(net, sockets=b.sockets, tcb=b.tcb)
+
+    bootstrap_end = int(cfg.bootstraptime * SECOND)
+    tcp = TCP(auto_close=False) if model.needs_tcp else None
+    stack = Stack(bootstrap_end=bootstrap_end, tcp=tcp)
+
+    if on_recv is None:
+        def on_recv(hs, slot, pkt, now, key):  # noqa: F811
+            from shadow_tpu.core.engine import Emit
+            return hs, Emit.none(1, N_PKT_ARGS)
+
+    base_handlers = stack.make_handlers(on_recv)
+    kind_base = len(base_handlers)
+    handlers = base_handlers + make_handlers(stack, kind_base)
+
+    if tcp is not None:
+        need = tcp.min_max_emit(model.app_rows())
+    else:
+        need = model.app_rows() + 1
+    max_emit = max(need, model.handler_rows())
+
+    lookahead = max(int(topo.min_latency_ms * MILLISECOND), 1)
+    ecfg = EngineConfig(
+        n_hosts=n_hosts, capacity=capacity, lookahead=lookahead,
+        max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
+    )
+    network = topo.build_network(host_vertex)
+    eng = Engine(ecfg, handlers, network)
+
+    # -- initial events: process starts (slave.c:296-336 scheduling of
+    # process start tasks at starttime)
+    evs = b.start_events
+    m = max(len(evs), 1)
+    init = Events.empty((m,), n_args=N_PKT_ARGS)
+    times = np.full((m,), TIME_INVALID, np.int64)
+    dsts = np.zeros((m,), np.int32)
+    seqs = np.zeros((m,), np.int32)
+    kinds = np.zeros((m,), np.int32)
+    argw = np.zeros((m, N_PKT_ARGS), np.int32)
+    per_src_seq: dict[int, int] = {}
+    for i, (t_ns, gid, kind_rel, args) in enumerate(evs):
+        times[i] = t_ns
+        dsts[i] = gid
+        seqs[i] = per_src_seq.get(gid, 0)
+        per_src_seq[gid] = seqs[i] + 1
+        kinds[i] = kind_base + kind_rel
+        for j, w in enumerate(args):
+            argw[i, j] = w
+    init = dataclasses.replace(
+        init,
+        time=jnp.asarray(times), dst=jnp.asarray(dsts),
+        src=jnp.asarray(dsts), seq=jnp.asarray(seqs),
+        kind=jnp.asarray(kinds), args=jnp.asarray(argw),
+    )
+
+    hosts_state = SimHost(net=net, app=app_state)
+    st0 = eng.init_state(hosts_state, init)
+    return Simulation(
+        engine=eng, state0=st0, stop_ns=int(cfg.stoptime * SECOND),
+        dns=dns, topo=topo, names=[h.name for h in hosts], app=model,
+        stack=stack,
+    )
+
+
+def default_registry() -> dict[str, Callable]:
+    from shadow_tpu.models.tgen import TGenModel
+    from shadow_tpu.models.phold_net import PholdNetModel
+
+    return {"tgen": TGenModel, "phold": PholdNetModel}

@@ -818,7 +818,11 @@ class TCP:
         tcb = _write_row(tcb, child, child_row, do_open)
         # byte accounting: UDP counts arrivals, TCP counts newly-delivered
         deliver_len = jnp.where(is_tcp, new_bytes, pkt.length)
-        deliver = is_udp | (is_tcp & (new_bytes > 0))
+        # app sees data deliveries AND stream EOF (the consumed FIN): the
+        # F_FIN bit in the delivered flags is re-synthesized to mean "the
+        # peer finished sending" — the app-visible recv()==0 the reference
+        # surfaces through descriptor status (tcp.c FIN -> readable EOF)
+        deliver = is_udp | (is_tcp & ((new_bytes > 0) | fin_new))
         sockets = sockets.add_rx(jnp.where(deliver, c, -1), deliver_len)
         hs = dataclasses.replace(
             hs,
@@ -828,7 +832,12 @@ class TCP:
         )
 
         # -- app delivery (once, after all state updates)
-        pkt2 = dataclasses.replace(pkt, length=deliver_len)
+        eof_flags = jnp.where(
+            is_tcp,
+            (pkt.flags & ~F_FIN) | jnp.where(fin_new, F_FIN, 0),
+            pkt.flags,
+        )
+        pkt2 = dataclasses.replace(pkt, length=deliver_len, flags=eof_flags)
         hs, app_em = on_recv(hs, jnp.where(deliver, slot, -1), pkt2, now, key)
         ours = _emit_from_rows([ctl, retx_row] + data_rows + [kick, timer_row])
         return hs, emit_concat(ours, app_em)
