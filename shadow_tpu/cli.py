@@ -58,6 +58,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="ignored (pthread-era flag; kept for compatibility)")
     p.add_argument("--scheduler-policy", "-p", default=None,
                    help="ignored (pthread-era flag; kept for compatibility)")
+    p.add_argument("--checkpoint-interval", type=float, default=0.0,
+                   help="write a checkpoint every N sim seconds (0=off)")
+    p.add_argument("--checkpoint-path", default="shadow_tpu.ckpt.npz",
+                   help="checkpoint file path (overwritten each interval)")
+    p.add_argument("--resume", default=None,
+                   help="resume from a checkpoint written by the same config")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
@@ -110,21 +116,40 @@ def main(argv=None) -> int:
           f"stoptime {cfg.stoptime:.0f}s, backend {jax.default_backend()}",
           file=sys.stderr)
 
-    run = jax.jit(sim.engine.run)
     st = sim.state0
-    stop_s = cfg.stoptime
-    # hb <= 0 disables heartbeats: one straight run to stoptime
-    hb = args.heartbeat_frequency if args.heartbeat_frequency > 0 else stop_s
     sim_s = 0.0
+    if args.resume:
+        from shadow_tpu.utils import load_checkpoint
+
+        st, meta = load_checkpoint(args.resume, sim.state0)
+        sim_s = float(jax.device_get(st.now)) / SECOND
+        print(f"resumed from {args.resume} at sim time {sim_s:.3f}s "
+              f"(meta: {meta})", file=sys.stderr)
+    stop_s = cfg.stoptime
+    # independent sim-time cadences; the run loop steps to whichever event
+    # (heartbeat print, checkpoint write, stoptime) comes next
+    hb = args.heartbeat_frequency
+    ck = args.checkpoint_interval
+    next_hb = sim_s + hb if hb > 0 else float("inf")
+    next_ckpt = sim_s + ck if ck > 0 else float("inf")
     t1 = time.perf_counter()
     while sim_s < stop_s:
-        nxt = min(sim_s + hb, stop_s)
-        st = run(st, jnp.int64(int(nxt * SECOND)))
+        nxt = min(next_hb, next_ckpt, stop_s)
+        st = sim.run(int(nxt * SECOND), state=st)
         st.now.block_until_ready()
         sim_s = nxt
-        if args.heartbeat_frequency > 0:
+        if sim_s >= next_hb:
             for line in _heartbeat_lines(st, sim.names, sim_s):
                 print(line)
+            next_hb += hb
+        if sim_s >= next_ckpt:
+            from shadow_tpu.utils import save_checkpoint
+
+            save_checkpoint(
+                args.checkpoint_path, st,
+                meta={"sim_seconds": sim_s, "seed": args.seed},
+            )
+            next_ckpt += ck
     wall = time.perf_counter() - t1
 
     stats = st.stats

@@ -1,0 +1,4 @@
+from shadow_tpu.utils.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    save_checkpoint,
+)

@@ -1,0 +1,105 @@
+"""Checkpoint/resume of simulation state.
+
+The reference has no checkpointing at all (SURVEY.md §5 "Checkpoint /
+resume: Absent" — its state is a heap-object web spread across pthread
+queues and green-thread stacks). Here the *entire* simulation — per-host
+event queues, TCP connection tables, NIC clocks, CoDel controllers, app
+state, RNG counters — is one pytree of device arrays (EngineState), so a
+checkpoint is just that pytree written to disk, and resume is bit-exact:
+the restored run produces the same event order and final state as the
+uninterrupted one (verified by tests/test_checkpoint.py).
+
+Format: a single .npz holding the flattened leaves by index, plus a JSON
+metadata blob recording leaf paths/shapes/dtypes for validation and a
+free-form user dict (config digest, sim time, version). Restoring requires
+a template state with identical tree structure (rebuild the simulation
+from the same config, then load into its state0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _leaf_paths(tree: Any) -> list[str]:
+    paths = []
+    for path, _leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        paths.append(jax.tree_util.keystr(path))
+    return paths
+
+
+def save_checkpoint(path: str, state: Any, meta: dict | None = None) -> None:
+    """Write `state` (any pytree of arrays) to `path` as .npz."""
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    leaves = jax.device_get(leaves)
+    header = {
+        "format_version": FORMAT_VERSION,
+        "n_leaves": len(leaves),
+        "paths": _leaf_paths(state),
+        "shapes": [list(np.shape(x)) for x in leaves],
+        "dtypes": [str(np.asarray(x).dtype) for x in leaves],
+        "meta": meta or {},
+    }
+    arrs = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrs["__header__"] = np.frombuffer(
+        json.dumps(header).encode("utf-8"), dtype=np.uint8
+    )
+    # write-then-rename so a crash mid-write (the very event checkpoints
+    # guard against) cannot destroy the previous good checkpoint
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrs)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:
+    """Load a checkpoint into the structure of `template`.
+
+    Returns (state, meta). Raises ValueError on structural mismatch —
+    checkpoint files are only portable across identical builds (same
+    config, host count, socket/queue capacities).
+    """
+    with np.load(path) as data:
+        header = json.loads(bytes(data["__header__"]).decode("utf-8"))
+        if header.get("format_version") != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format {header.get('format_version')} != "
+                f"{FORMAT_VERSION}"
+            )
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        if header["n_leaves"] != len(t_leaves):
+            raise ValueError(
+                f"checkpoint has {header['n_leaves']} leaves, template has "
+                f"{len(t_leaves)} — was it built from the same config?"
+            )
+        paths = _leaf_paths(template)
+        if header["paths"] != paths:
+            diff = [
+                f"  {a} (checkpoint) vs {b} (template)"
+                for a, b in zip(header["paths"], paths)
+                if a != b
+            ]
+            raise ValueError(
+                "checkpoint tree structure differs from template:\n"
+                + "\n".join(diff[:10])
+            )
+        new_leaves = []
+        for i, (tmpl, pth) in enumerate(zip(t_leaves, paths)):
+            arr = data[f"leaf_{i}"]
+            want_shape = tuple(np.shape(tmpl))
+            want_dtype = np.asarray(tmpl).dtype if not hasattr(tmpl, "dtype") else tmpl.dtype
+            if arr.shape != want_shape or str(arr.dtype) != str(want_dtype):
+                raise ValueError(
+                    f"leaf {i} ({pth}): checkpoint {arr.shape}/{arr.dtype} vs "
+                    f"template {want_shape}/{want_dtype}"
+                )
+            new_leaves.append(jax.numpy.asarray(arr))
+        state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return state, header.get("meta", {})
