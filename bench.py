@@ -59,7 +59,7 @@ def python_baseline_rate(
     return sorted(rates)[len(rates) // 2]
 
 
-def tpu_rate(stop_s: int):
+def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY):
     import jax
     import jax.numpy as jnp
 
@@ -68,11 +68,13 @@ def tpu_rate(stop_s: int):
 
     eng, init = phold.build(
         N_HOSTS,
-        capacity=CAPACITY,
+        capacity=capacity,
         latency_ns=seconds(LATENCY_S),
         mean_delay_ns=seconds(MEAN_DELAY_S),
         msgs_per_host=MSGS_PER_HOST,
         seed=SEED,
+        hot_hosts=hot_hosts,
+        hot_weight=hot_weight,
     )
     run = jax.jit(eng.run)
 
@@ -94,6 +96,7 @@ def tpu_rate(stop_s: int):
         "events_per_s": executed / wall,
         "sim_s_per_wall_s": stop_s / wall,
         "windows": int(st.stats.n_windows),
+        "drops": int(st.queues.drops.sum()),
         "device": str(dev.device_kind),
         "n_hosts": N_HOSTS,
     }
@@ -103,6 +106,12 @@ def main():
     stop_s = int(sys.argv[1]) if len(sys.argv) > 1 else STOP_SIM_SECONDS
     py_rate = python_baseline_rate()
     r = tpu_rate(stop_s)
+    # hot-spot variant: 1.5% of hosts receive 30% of traffic (the skewed
+    # workload of reference test_phold.c:36-52 weighted targets); larger
+    # queues absorb the hot hosts' backlog
+    rs = tpu_rate(
+        min(stop_s, 10), hot_hosts=64, hot_weight=0.3, capacity=256
+    )
     out = {
         "metric": "phold_events_per_sec",
         "value": round(r["events_per_s"], 1),
@@ -114,6 +123,10 @@ def main():
         "events": r["events"],
         "wall_s": round(r["wall_s"], 3),
         "windows": r["windows"],
+        "drops": r["drops"],
+        "skew_events_per_s": round(rs["events_per_s"], 1),
+        "skew_sim_s_per_wall_s": round(rs["sim_s_per_wall_s"], 3),
+        "skew_drops": rs["drops"],
         "device": r["device"],
     }
     print(json.dumps(out))

@@ -54,6 +54,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-level", "-l", default="message",
                    choices=["error", "critical", "warning", "message",
                             "info", "debug"])
+    p.add_argument("--mesh", type=int, default=0,
+                   help="shard hosts over N devices (0 = single device; "
+                        "the TPU-era --workers)")
     p.add_argument("--workers", "-w", type=int, default=None,
                    help="ignored (pthread-era flag; kept for compatibility)")
     p.add_argument("--scheduler-policy", "-p", default=None,
@@ -107,14 +110,43 @@ def main(argv=None) -> int:
         cfg = dataclasses.replace(cfg, bootstraptime=args.bootstrap_end)
 
     t0 = time.perf_counter()
+    mesh = None
+    if args.mesh:
+        from shadow_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh)
     sim = build_simulation(
-        cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity
+        cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity,
+        mesh=mesh,
     )
     n_hosts = len(sim.names)
     print(f"shadow_tpu {__version__}: {n_hosts} hosts, "
           f"{sim.topo.n_vertices} topology vertices, "
-          f"stoptime {cfg.stoptime:.0f}s, backend {jax.default_backend()}",
+          f"stoptime {cfg.stoptime:.0f}s, backend {jax.default_backend()}"
+          + (f", mesh {args.mesh}" if args.mesh else ""),
           file=sys.stderr)
+
+    # digest ties a checkpoint to the exact build inputs: resuming under a
+    # different config or seed would pass structural checks yet silently
+    # break the bit-exact-resume guarantee. Hash *content*, not paths:
+    # topology via its resolved source text, config minus base_dir — so
+    # moving an identical config+checkpoint elsewhere still resumes, while
+    # editing the referenced GraphML is caught
+    import hashlib
+
+    cfg_digest = hashlib.sha256(
+        repr(
+            (
+                # stoptime excluded: resuming toward a later stop is the
+                # normal use; it never affects per-event determinism
+                dataclasses.replace(cfg, base_dir="", stoptime=0.0),
+                cfg.topology_source(),
+                args.seed,
+                args.sockets,
+                args.capacity,
+            )
+        ).encode()
+    ).hexdigest()[:16]
 
     st = sim.state0
     sim_s = 0.0
@@ -122,16 +154,30 @@ def main(argv=None) -> int:
         from shadow_tpu.utils import load_checkpoint
 
         st, meta = load_checkpoint(args.resume, sim.state0)
+        if meta.get("seed") is not None and meta["seed"] != args.seed:
+            print(f"error: checkpoint was written with --seed {meta['seed']}"
+                  f" but this run uses --seed {args.seed}; resume would not "
+                  "be bit-exact", file=sys.stderr)
+            return 2
+        if meta.get("config_digest") not in (None, cfg_digest):
+            print("error: checkpoint config digest "
+                  f"{meta['config_digest']} != this build's {cfg_digest}; "
+                  "it was written from a different config", file=sys.stderr)
+            return 2
         sim_s = float(jax.device_get(st.now)) / SECOND
         print(f"resumed from {args.resume} at sim time {sim_s:.3f}s "
               f"(meta: {meta})", file=sys.stderr)
     stop_s = cfg.stoptime
     # independent sim-time cadences; the run loop steps to whichever event
-    # (heartbeat print, checkpoint write, stoptime) comes next
+    # (heartbeat print, checkpoint write, stoptime) comes next. Cadences
+    # are absolute interval multiples, so an interrupted+resumed run emits
+    # heartbeats/checkpoints at the same sim times as an uninterrupted one
+    import math
+
     hb = args.heartbeat_frequency
     ck = args.checkpoint_interval
-    next_hb = sim_s + hb if hb > 0 else float("inf")
-    next_ckpt = sim_s + ck if ck > 0 else float("inf")
+    next_hb = (math.floor(sim_s / hb) + 1) * hb if hb > 0 else float("inf")
+    next_ckpt = (math.floor(sim_s / ck) + 1) * ck if ck > 0 else float("inf")
     t1 = time.perf_counter()
     while sim_s < stop_s:
         nxt = min(next_hb, next_ckpt, stop_s)
@@ -147,7 +193,8 @@ def main(argv=None) -> int:
 
             save_checkpoint(
                 args.checkpoint_path, st,
-                meta={"sim_seconds": sim_s, "seed": args.seed},
+                meta={"sim_seconds": sim_s, "seed": args.seed,
+                      "config_digest": cfg_digest},
             )
             next_ckpt += ck
     wall = time.perf_counter() - t1

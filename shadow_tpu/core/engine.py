@@ -18,6 +18,22 @@ vmapped kernel over [H]-leading state arrays; the inner drain loop is a
 next-event times (`lax.pmin` across the device mesh when sharded). One
 "round" of the reference's pthread barrier dance is one iteration of the
 outer while loop here — no locks, no threads, no barrier waits.
+
+Drain algorithm (v2, batched): each outer iteration extracts every host's
+frontier — its `drain_batch` earliest below-barrier events, in
+(time, src, seq) order, via one multi-key `lax.sort` of the queue rows —
+then an inner while_loop executes frontier positions one at a time across
+all hosts (vmapped), buffering emitted events. Routed pushes and the
+cross-shard exchange run once per outer iteration instead of once per
+event, which amortizes the sort/scatter cost over the whole batch. The
+reference's per-host drain semantics (pop everything below the barrier,
+scheduler_policy_host_single.c:210-271) are preserved exactly: a host
+stops executing its frontier early only when an event it just emitted
+could precede a remaining frontier event in the total order — the next
+outer iteration then re-sorts and continues. Because cross-host sends are
+clamped to the window barrier, the inner loop needs no collectives, so
+each shard drains with its own trip count and only the outer loop
+synchronizes.
 """
 
 from __future__ import annotations
@@ -29,7 +45,13 @@ import jax
 import jax.numpy as jnp
 
 from shadow_tpu.core import rng as srng
-from shadow_tpu.core.events import N_ARGS, EventQueue, Events, queue_pop, queue_push
+from shadow_tpu.core.events import (
+    N_ARGS,
+    EventQueue,
+    Events,
+    group_run_starts,
+    queue_push,
+)
 from shadow_tpu.core.timebase import TIME_INVALID
 
 
@@ -143,6 +165,9 @@ class EngineConfig:
     n_args: int = N_ARGS
     seed: int = 0
     axis_name: str | None = None  # mesh axis hosts are sharded over
+    n_shards: int = 1  # static mesh axis size (1 when unsharded)
+    drain_batch: int = 32  # B: frontier events extracted per host per sweep
+    route_bucket: int = 0  # per-peer all_to_all bucket slots (0 = auto)
 
     def __post_init__(self):
         # a window of width 0 can never drain an event: the compiled outer
@@ -151,6 +176,12 @@ class EngineConfig:
         # (master.c:133-159 minTimeJump floor).
         if self.lookahead < 1:
             raise ValueError(f"lookahead must be >= 1 ns, got {self.lookahead}")
+        # a non-positive bucket can never send an event: the exchange loop
+        # would spin forever on-device with no Python escape
+        if self.route_bucket < 0:
+            raise ValueError(
+                f"route_bucket must be >= 0, got {self.route_bucket}"
+            )
 
 
 def _select_rows(mask: jax.Array, new: Any, old: Any) -> Any:
@@ -187,18 +218,77 @@ class Engine:
             return jax.lax.psum(x.astype(jnp.int32), self.cfg.axis_name) > 0
         return x
 
-    def _exchange(self, ev: Events, mask: jax.Array):
-        """Make every shard see every emitted event (v1: all_gather ring).
+    def _exchange_push(self, q: EventQueue, ev: Events, mask: jax.Array, host0):
+        """Push a flat routed batch, delivering cross-shard events by
+        bucketed all_to_all.
 
-        Each shard then keeps only events addressed to its own host range
-        inside queue_push. TODO(perf): replace with ppermute/all_to_all so
-        traffic scales with cross-shard packets, not total packets.
+        Hosts are block-partitioned over the mesh axis (gid // n_hosts is
+        the owning shard), so same-shard events push directly. Cross-shard
+        events are grouped by destination shard into a [S, R] bucket and
+        exchanged with `lax.all_to_all`; if any destination's load exceeds
+        the R bucket slots, the loop runs another round with the remainder
+        — lossless, and traffic scales with the cross-shard packet count
+        rather than total packets (the TPU-native replacement for the
+        reference's shared-memory scheduler_push across threads,
+        scheduler.c:342-360; SURVEY.md §2.4).
         """
         if self.cfg.axis_name is None:
-            return ev, mask
-        ax = self.cfg.axis_name
-        g = lambda x: jax.lax.all_gather(x, ax, tiled=True)
-        return jax.tree.map(g, ev), g(mask)
+            return queue_push(q, ev, mask, host0)
+        cfg = self.cfg
+        ax = cfg.axis_name
+        h, s = cfg.n_hosts, cfg.n_shards
+        my = jax.lax.axis_index(ax).astype(jnp.int32)
+        m = ev.time.shape[0]
+        # default bucket: a quarter of the uniform-traffic worst case —
+        # small enough that lightly-coupled shards don't pay Θ(batch) ICI
+        # traffic every iteration, large enough that uniform workloads
+        # rarely need a second round (overflow just loops, lossless)
+        r = cfg.route_bucket or max(16, -(-m // s) // 4)
+
+        dshard = ev.dst // jnp.int32(h)
+        in_range = (dshard >= 0) & (dshard < s)
+        is_local = mask & (dshard == my)
+        q = queue_push(q, ev, is_local, host0)
+        remaining = mask & in_range & ~is_local
+
+        pos = jnp.arange(m, dtype=jnp.int32)
+
+        def cond(carry):
+            _, rem = carry
+            return jax.lax.psum(jnp.any(rem).astype(jnp.int32), ax) > 0
+
+        def body(carry):
+            q, rem = carry
+            dkey = jnp.where(rem, dshard, s)
+            order = jnp.argsort(dkey, stable=True)
+            sd = dkey[order]
+            rank = pos - group_run_starts(sd)
+            sel = (sd < s) & (rank < r)
+
+            brow = jnp.where(sel, sd, s)
+            bcol = jnp.minimum(rank, r - 1)
+            evo = ev.at(order)
+            bucket = Events(
+                time=jnp.full((s, r), TIME_INVALID, jnp.int64)
+                .at[brow, bcol].set(evo.time, mode="drop"),
+                dst=jnp.zeros((s, r), jnp.int32).at[brow, bcol].set(evo.dst, mode="drop"),
+                src=jnp.zeros((s, r), jnp.int32).at[brow, bcol].set(evo.src, mode="drop"),
+                seq=jnp.zeros((s, r), jnp.int32).at[brow, bcol].set(evo.seq, mode="drop"),
+                kind=jnp.zeros((s, r), jnp.int32).at[brow, bcol].set(evo.kind, mode="drop"),
+                args=jnp.zeros((s, r, cfg.n_args), jnp.int32)
+                .at[brow, bcol].set(evo.args, mode="drop"),
+            )
+            recv = jax.tree.map(
+                lambda x: jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0),
+                bucket,
+            )
+            recv_flat = recv.flatten()
+            q2 = queue_push(q, recv_flat, recv_flat.time != TIME_INVALID, host0)
+            sent = jnp.zeros((m,), bool).at[order].set(sel)
+            return q2, rem & ~sent
+
+        q, _ = jax.lax.while_loop(cond, body, (q, remaining))
+        return q
 
     # -- state construction -------------------------------------------------
     def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
@@ -225,14 +315,17 @@ class Engine:
             stats=Stats.create(cfg.n_hosts),
         )
 
-    # -- one pop/execute/route/push sweep over all hosts --------------------
-    def _sweep(self, carry, window_end: jax.Array, host0: jax.Array):
-        q, hosts, src_seq, exec_cnt, stats = carry
+    # -- execute one frontier position across all hosts ---------------------
+    def _execute_step(self, hosts, src_seq, exec_cnt, stats, ev: Events,
+                      active: jax.Array, window_end: jax.Array, gids: jax.Array):
+        """Run handlers for one event per host (masked), route the emits.
+
+        Returns (hosts', src_seq', exec_cnt', stats', routed Events[H, K],
+        final_mask[H, K], local_below[H, K] times of local emits below the
+        barrier for the frontier-safety check).
+        """
         cfg = self.cfg
         h, k = cfg.n_hosts, cfg.max_emit
-        gids = host0 + jnp.arange(h, dtype=jnp.int32)
-
-        q, ev, active = queue_pop(q, window_end, gids)
 
         hkeys, rkeys = srng.event_keys(self._base_key, gids, exec_cnt)
 
@@ -288,8 +381,9 @@ class Engine:
             kind=emit.kind,
             args=emit.args,
         )
-        out_flat, mask_flat = self._exchange(out.flatten(), final_mask.reshape(-1))
-        q = queue_push(q, out_flat, mask_flat, host0)
+        local_below = jnp.where(
+            final_mask & is_local & (t < window_end), t, TIME_INVALID
+        )
 
         exec_cnt = exec_cnt + active.astype(jnp.int32)
         stats = dataclasses.replace(
@@ -298,19 +392,94 @@ class Engine:
             n_emitted=stats.n_emitted + jnp.sum(inc, axis=1, dtype=jnp.int64),
             n_net_dropped=stats.n_net_dropped + jnp.sum(dropped, axis=1, dtype=jnp.int64),
         )
-        return (q, hosts, src_seq, exec_cnt, stats)
+        return hosts, src_seq, exec_cnt, stats, out, final_mask, local_below
 
     # -- window = drain all events below the barrier ------------------------
     def _drain_window(self, st: EngineState, window_end, host0):
-        def cond(carry):
+        cfg = self.cfg
+        h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
+        b = max(1, min(cfg.drain_batch, c))
+        gids = host0 + jnp.arange(h, dtype=jnp.int32)
+        i64max = jnp.iinfo(jnp.int64).max
+
+        def outer_cond(carry):
             q = carry[0]
             return self._gany(jnp.any(q.min_time() < window_end))
 
-        def body(carry):
-            return self._sweep(carry, window_end, host0)
+        def outer_body(carry):
+            q, hosts, src_seq, exec_cnt, stats = carry
+
+            # frontier extraction: queue rows are sorted by (time, src, seq)
+            # with empties last (events.py invariant), so each host's b
+            # earliest below-barrier events are simply its first b columns
+            bt = q.time[:, :b]
+            bsrc, bseq = q.src[:, :b], q.seq[:, :b]
+            bkind, bargs = q.kind[:, :b], q.args[:, :b]
+            bvalid = bt < window_end
+
+            # emit buffer: routed events from every frontier position
+            ebuf = Events.empty((b, h, k), n_args=cfg.n_args)
+            emask0 = jnp.zeros((b, h, k), bool)
+            executed0 = jnp.zeros((b, h), bool)
+
+            def inner_cond(ic):
+                bi, _, _, _, _, min_emit, _, _, _ = ic
+                col = jax.lax.dynamic_index_in_dim(bt, bi, 1, keepdims=False)
+                vcol = jax.lax.dynamic_index_in_dim(bvalid, bi, 1, keepdims=False)
+                return (bi < b) & jnp.any(vcol & (col < min_emit))
+
+            def inner_body(ic):
+                (bi, hosts, src_seq, exec_cnt, stats, min_emit, ebuf, emask,
+                 executed) = ic
+                col = lambda a: jax.lax.dynamic_index_in_dim(a, bi, 1, keepdims=False)
+                ev_t = col(bt)
+                active = col(bvalid) & (ev_t < min_emit)
+                ev = Events(
+                    time=jnp.where(active, ev_t, TIME_INVALID),
+                    dst=gids,
+                    src=col(bsrc),
+                    seq=col(bseq),
+                    kind=col(bkind),
+                    args=col(bargs),
+                )
+                (hosts, src_seq, exec_cnt, stats, out, fmask,
+                 local_below) = self._execute_step(
+                    hosts, src_seq, exec_cnt, stats, ev, active, window_end, gids
+                )
+                upd = lambda buf, x: jax.lax.dynamic_update_index_in_dim(buf, x, bi, 0)
+                ebuf = jax.tree.map(upd, ebuf, out)
+                emask = upd(emask, fmask)
+                executed = upd(executed, active)
+                min_emit = jnp.minimum(min_emit, jnp.min(local_below, axis=1))
+                return (bi + 1, hosts, src_seq, exec_cnt, stats, min_emit,
+                        ebuf, emask, executed)
+
+            (_, hosts, src_seq, exec_cnt, stats, _, ebuf, emask,
+             executed) = jax.lax.while_loop(
+                inner_cond,
+                inner_body,
+                (jnp.int32(0), hosts, src_seq, exec_cnt, stats,
+                 jnp.full((h,), i64max, jnp.int64), ebuf, emask0, executed0),
+            )
+
+            # executed frontier positions form a prefix of each row (the
+            # inner loop's active mask is monotone), so the clear is an
+            # elementwise column-index compare — no scatter. The push's row
+            # re-sort restores the sorted-rows invariant afterwards.
+            n_exec = jnp.sum(executed, axis=0, dtype=jnp.int32)  # [H]
+            cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < n_exec[:, None]
+            q = dataclasses.replace(
+                q, time=jnp.where(cleared, TIME_INVALID, q.time)
+            )
+            q = self._exchange_push(
+                q, ebuf.flatten(), emask.reshape(-1), host0
+            )
+            return (q, hosts, src_seq, exec_cnt, stats)
 
         carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats)
-        q, hosts, src_seq, exec_cnt, stats = jax.lax.while_loop(cond, body, carry)
+        q, hosts, src_seq, exec_cnt, stats = jax.lax.while_loop(
+            outer_cond, outer_body, carry
+        )
         return dataclasses.replace(
             st,
             queues=q,

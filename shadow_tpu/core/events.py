@@ -7,12 +7,16 @@ over events as the tuple (time, dstHostID, srcHostID, per-src sequence)
 (reference: src/main/core/work/event.c:110-153).
 
 Here every host's queue is a fixed-capacity slot array; all hosts' queues
-form [H, C] device arrays. Pop-min is a masked reduction per row (so it
-vectorizes over all hosts at once on the VPU); push is a sort-based batch
-scatter that assigns each incoming event a distinct free slot, so the
-scatter is collision-free and therefore deterministic. Slot order carries
-no meaning — ordering lives entirely in the (time, src, seq) key — so the
-queue needs no heap maintenance at all.
+form [H, C] device arrays. Rows maintain a **sorted invariant**: slots are
+ordered by the event key (time, src, seq) with empty slots
+(time == TIME_INVALID) at the end. That choice is TPU-motivated: XLA
+scatters with computed indices serialize on TPU (~ms for tens of
+thousands of updates), while row-wise `lax.sort` is fast VPU work — so
+push is implemented as "group incoming events by destination via one flat
+sort, slice each host's contiguous run, concatenate to the row, re-sort
+the row" with no scatter anywhere, and pop-min / frontier extraction are
+free prefix reads of the sorted rows. Bounded capacity drops the
+*largest*-key events on overflow and accounts them in `drops`.
 """
 
 from __future__ import annotations
@@ -130,13 +134,38 @@ class EventQueue:
         return jnp.min(self.time, axis=1)
 
 
-def _tiebreak_key(src: jax.Array, seq: jax.Array) -> jax.Array:
-    """Pack (src, seq) into one i64 so a single argmin resolves ties.
+def group_run_starts(sorted_group_ids: jax.Array) -> jax.Array:
+    """Index where each position's group run begins, for a group-sorted
+    1-D array (associative max-scan over run boundaries). Subtracting it
+    from the position index yields each element's rank within its group.
+    """
+    n = sorted_group_ids.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_group_ids[1:] != sorted_group_ids[:-1]]
+    )
+    return jax.lax.associative_scan(jnp.maximum, jnp.where(boundary, pos, 0))
+
+
+def pack_srcseq(src: jax.Array, seq: jax.Array) -> jax.Array:
+    """Pack (src, seq) into one i64 preserving lexicographic order.
 
     Within one host's queue, dst is constant, so the reference's total order
-    (time, dst, src, seq) (event.c:110-153) reduces to (time, src, seq).
+    (time, dst, src, seq) (event.c:110-153) reduces to (time, src, seq);
+    this packing lets a single compare/sort operand resolve the tie. seq is
+    masked through u32 so a (never expected) negative value cannot
+    sign-extend into the src bits.
     """
     return (src.astype(jnp.int64) << 32) | seq.astype(jnp.uint32).astype(jnp.int64)
+
+
+def unpack_srcseq(p: jax.Array) -> tuple[jax.Array, jax.Array]:
+    return (
+        (p >> 32).astype(jnp.int32),
+        (p & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32),
+    )
+
+
 
 
 def queue_pop(
@@ -155,7 +184,7 @@ def queue_pop(
     t = q.time
     min_t = jnp.min(t, axis=1)  # i64[H]
     is_min = t == min_t[:, None]
-    key2 = jnp.where(is_min, _tiebreak_key(q.src, q.seq), jnp.iinfo(jnp.int64).max)
+    key2 = jnp.where(is_min, pack_srcseq(q.src, q.seq), jnp.iinfo(jnp.int64).max)
     slot = jnp.argmin(key2, axis=1)  # i32[H]
     active = min_t < before
 
@@ -182,52 +211,167 @@ def queue_push(
 
     `host0` is the global id of this shard's first host; events whose dst
     falls outside [host0, host0 + H) are silently ignored (the caller routes
-    cross-shard events via collectives before pushing). Overflowing events
-    (destination queue full) are dropped and counted in `drops`, mirroring
-    where the reference would grow its unbounded heap.
+    cross-shard events via collectives before pushing). When a destination
+    queue overflows its capacity, the *largest*-key events are dropped and
+    counted in `drops` (the reference's heaps are unbounded; we bound and
+    account — src/main/core/support/object_counter.c spirit).
 
-    Algorithm: sort events by local dst (stable), rank each event within its
-    dst run, list each queue's free slots in slot order (argsort of the
-    occupancy mask — False sorts first), and give the rank-th event the
-    rank-th free slot. Every surviving event gets a distinct (row, slot), so
-    the scatter has no collisions and the result is order-deterministic.
+    Scatter-AND-gather-free algorithm (TPU: both computed-index scatters
+    and large gathers run orders of magnitude slower than `lax.sort`, so
+    everything is expressed as two sorts + elementwise ops):
+
+    1. One flat multi-key sort groups incoming events by destination in
+       (time, src, seq) order. Per-destination ranks come from an
+       associative max-scan over run boundaries; per-destination counts
+       from two searchsorteds.
+    2. One global multi-key sort over the concatenation of
+       [all existing slots | grouped incoming | fillers] with key
+       (row, time, src, seq). Each host row contributes its C existing
+       slots; incoming events ranked below the cap W route to their row
+       (rank >= W overflows — those could never fit and are counted as
+       drops); exactly W - count fillers per row pad every row segment to
+       a fixed C + W length, so after the sort a plain reshape yields the
+       merged, key-sorted rows. Truncating to C drops the largest keys.
+
+    The 9-word args payload does not ride the sorts; each entry carries
+    its position into a virtual [q.args ; ev.args ; zero] table and args
+    are materialized with a single final gather. The row re-sort also
+    repairs rows whose invariant was broken by the engine's prefix-clear
+    of executed events.
     """
     h, c = q.n_hosts, q.capacity
     m = ev.time.shape[0]
+    a = q.args.shape[-1]
+    i64max = jnp.iinfo(jnp.int64).max
 
     local = ev.dst - jnp.asarray(host0, jnp.int32)
-    ok = mask & (local >= 0) & (local < h)
-    dkey = jnp.where(ok, local, h)  # out-of-shard / masked events sort last
-    order = jnp.argsort(dkey, stable=True)
-    sd = dkey[order]  # i32[M] sorted local dst
+    ok = mask & (local >= 0) & (local < h) & (ev.time != TIME_INVALID)
 
-    pos = jnp.arange(m, dtype=jnp.int32)
-    run_start = jnp.where(
-        jnp.concatenate([jnp.ones((1,), bool), sd[1:] != sd[:-1]]), pos, 0
+    pk, unpk = pack_srcseq, unpack_srcseq
+
+    # payload (kind + args words) rides the sorts directly, bit-packed in
+    # i64 pairs, when narrow; wide payloads instead carry a position into
+    # a virtual [q rows ; ev rows ; zero row] table gathered once at the
+    # end (one gather of [H, C] rows — still no computed-index scatter)
+    ride = (1 + a) <= 5
+
+    def pack_words(words):  # list of i32[N] -> list of i64[N]
+        out = []
+        for i in range(0, len(words), 2):
+            hi = words[i].astype(jnp.int64) << 32
+            lo = (
+                words[i + 1].astype(jnp.int64) & 0xFFFFFFFF
+                if i + 1 < len(words)
+                else 0
+            )
+            out.append(hi | lo)
+        return out
+
+    def unpack_words(packed, n):  # list of i64[...] -> n i32[...] words
+        words = []
+        for i, p in enumerate(packed):
+            words.append((p >> 32).astype(jnp.int32))
+            if 2 * i + 1 < n:
+                words.append((p & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32))
+        return words[:n]
+
+    # -- 1. group incoming by destination in (time, src, seq) order, so
+    # the rank cap below admits each destination's *smallest*-key events —
+    # which events survive overflow then depends only on keys, never on
+    # batch composition (keeps single-vs-sharded runs identical even when
+    # queues overflow: "keep the C smallest" commutes with batch splits)
+    dkey = jnp.where(ok, local, h)
+    in_ss = pk(ev.src, ev.seq)
+    pos32 = jnp.arange(m, dtype=jnp.int32)
+    if ride:
+        in_pay = pack_words([ev.kind] + [ev.args[:, i] for i in range(a)])
+        sdst, st, sss, *gpay = jax.lax.sort(
+            (dkey, ev.time, in_ss, *in_pay), num_keys=3
+        )
+    else:
+        sdst, st, sss, spos = jax.lax.sort(
+            (dkey, ev.time, in_ss, pos32), num_keys=3
+        )
+        gpay = [spos + h * c]  # table position of the args row
+
+    rank = pos32 - group_run_starts(sdst)
+
+    hosts = jnp.arange(h, dtype=jnp.int32)
+    count = (
+        jnp.searchsorted(sdst, hosts, side="right")
+        - jnp.searchsorted(sdst, hosts, side="left")
+    ).astype(jnp.int32)
+
+    # -- 2. global merge sort of existing + incoming + fillers, key =
+    # (row, time, srcseq). Each row contributes its C existing slots,
+    # its rank<W incoming (rank >= W could never fit: counted as drops),
+    # and exactly W-count fillers, so every row segment is C + W long and
+    # a reshape recovers the merged rows.
+    w = min(c, m)
+    row_ex = jnp.broadcast_to(hosts[:, None], (h, c)).reshape(-1)
+    row_in = jnp.where((sdst < h) & (rank < w), sdst, h)
+    need = jnp.maximum(w - count, 0)
+    jidx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    row_f = jnp.where(jidx < need[:, None], hosts[:, None], h).reshape(-1)
+
+    nf = h * w
+    cat = lambda ex, inc, fill_val, dtype: jnp.concatenate(
+        [ex.reshape(-1), inc, jnp.full((nf,), fill_val, dtype)]
     )
-    run_start = jax.lax.associative_scan(jnp.maximum, run_start)
-    rank = pos - run_start  # position within the same-dst run
-
-    occupied = q.valid()
-    free_order = jnp.argsort(occupied, axis=1, stable=True)  # free slots first
-    free_cnt = c - jnp.sum(occupied, axis=1, dtype=jnp.int32)
-
-    row = jnp.minimum(sd, h - 1)
-    slot = free_order[row, jnp.minimum(rank, c - 1)]
-    live = (sd < h) & (rank < free_cnt[row])
-    over = (sd < h) & ~live
-
-    # mode="drop" discards writes for dead rows instead of writing garbage
-    # (a dead row sharing a clamped (row, slot) with a live one would race).
-    drow = jnp.where(live, row, h)
-    evo = ev.at(order)
-    new = dataclasses.replace(
-        q,
-        time=q.time.at[drow, slot].set(evo.time, mode="drop"),
-        src=q.src.at[drow, slot].set(evo.src, mode="drop"),
-        seq=q.seq.at[drow, slot].set(evo.seq, mode="drop"),
-        kind=q.kind.at[drow, slot].set(evo.kind, mode="drop"),
-        args=q.args.at[drow, slot].set(evo.args, mode="drop"),
-        drops=q.drops.at[jnp.where(over, row, h)].add(1, mode="drop"),
+    rkey = jnp.concatenate([row_ex, row_in, row_f])
+    times = cat(q.time, st, i64max, jnp.int64)
+    srcseqs = cat(pk(q.src, q.seq), sss, i64max, jnp.int64)
+    if ride:
+        ex_pay = pack_words(
+            [q.kind.reshape(-1)] + [q.args[:, :, i].reshape(-1) for i in range(a)]
+        )
+        pays = [
+            cat(e, g, 0, jnp.int64) for e, g in zip(ex_pay, gpay)
+        ]
+    else:
+        pays = [
+            cat(
+                jnp.arange(h * c, dtype=jnp.int32).reshape(h, c),
+                gpay[0].astype(jnp.int32),
+                h * c + m,
+                jnp.int32,
+            )
+        ]
+    rkey, times, srcseqs, *pays = jax.lax.sort(
+        (rkey, times, srcseqs, *pays), num_keys=3
     )
-    return new
+
+    # every row segment has exactly C + W entries; reshape and truncate
+    seg = lambda x: x[: h * (c + w)].reshape(h, c + w)[:, :c]
+    mt = seg(times)
+    tail = times[: h * (c + w)].reshape(h, c + w)[:, c:]
+    over = jnp.sum(tail != TIME_INVALID, axis=1, dtype=jnp.int32) + jnp.maximum(
+        count - w, 0
+    )
+    new_src, new_seq = unpk(seg(srcseqs))
+
+    if ride:
+        words = unpack_words([seg(p) for p in pays], 1 + a)
+        new_kind = words[0]
+        new_args = jnp.stack(words[1:], axis=-1)
+    else:
+        table = jnp.concatenate(
+            [
+                jnp.concatenate(
+                    [q.kind.reshape(h * c, 1), q.args.reshape(h * c, a)], axis=1
+                ),
+                jnp.concatenate([ev.kind[:, None], ev.args], axis=1),
+                jnp.zeros((1, 1 + a), jnp.int32),
+            ]
+        )
+        ka = jnp.take(table, seg(pays[0]), axis=0)
+        new_kind = ka[:, :, 0]
+        new_args = ka[:, :, 1:]
+    return EventQueue(
+        time=mt,
+        src=new_src,
+        seq=new_seq,
+        kind=new_kind,
+        args=new_args,
+        drops=q.drops + over,
+    )

