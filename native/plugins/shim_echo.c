@@ -1,0 +1,95 @@
+/* shim_echo.c — demo client/server plugin for the shim runtime.
+ *
+ * The analog of the reference's dual-run test programs (SURVEY.md §4):
+ * a real C program, compiled to a .so and executed inside the simulation
+ * on a green thread, moving actual bytes over the simulated TCP stack.
+ *
+ * Usage (argv):
+ *   shim_echo server <port> <nbytes>
+ *       accept one connection, read until EOF, echo the bytes back
+ *       (xor'd with 0x5A so the test can prove the payload made a round
+ *       trip through both endpoints, not just a counter), close.
+ *   shim_echo client <server-name> <port> <nbytes>
+ *       connect, send nbytes of a deterministic pattern, half-close,
+ *       read the reply, verify it, exit 0 on success.
+ */
+
+#include "shim_api.h"
+
+#include <stdlib.h>
+#include <string.h>
+#include <stdio.h>
+
+static unsigned char pattern(long i) {
+    return (unsigned char)((i * 131 + 7) & 0xFF);
+}
+
+static int run_server(const ShimAPI* a, int port, long nbytes) {
+    void* c = a->ctx;
+    int lfd = a->sock_socket(c);
+    if (a->sock_listen(c, lfd, port) != 0) return 10;
+    int fd = a->sock_accept(c, lfd);
+    if (fd < 0) return 11;
+
+    char* buf = (char*)malloc((size_t)nbytes);
+    long got = 0;
+    for (;;) {
+        int64_t n = a->sock_recv(c, fd, buf + got, nbytes - got);
+        if (n < 0) return 12;
+        if (n == 0) break; /* client half-closed */
+        got += (long)n;
+        if (got >= nbytes) break;
+    }
+    if (got != nbytes) return 13;
+    for (long i = 0; i < nbytes; i++) buf[i] ^= 0x5A;
+    if (a->sock_send(c, fd, buf, nbytes) != nbytes) return 14;
+    a->sock_close(c, fd);
+    char msg[128];
+    snprintf(msg, sizeof(msg), "server echoed %ld bytes at t=%lld", nbytes,
+             (long long)a->time_ns(c));
+    a->log_msg(c, msg);
+    free(buf);
+    return 0;
+}
+
+static int run_client(const ShimAPI* a, const char* host, int port,
+                      long nbytes) {
+    void* c = a->ctx;
+    int fd = a->sock_socket(c);
+    if (a->sock_connect(c, fd, host, port) != 0) return 20;
+
+    char* buf = (char*)malloc((size_t)nbytes);
+    for (long i = 0; i < nbytes; i++) buf[i] = (char)pattern(i);
+    if (a->sock_send(c, fd, buf, nbytes) != nbytes) return 21;
+    a->sock_close(c, fd); /* half-close: server reads EOF */
+
+    long got = 0;
+    for (;;) {
+        int64_t n = a->sock_recv(c, fd, buf + got, nbytes - got);
+        if (n < 0) return 22;
+        if (n == 0) break;
+        got += (long)n;
+        if (got >= nbytes) break;
+    }
+    if (got != nbytes) return 23;
+    for (long i = 0; i < nbytes; i++) {
+        if ((unsigned char)buf[i] != (pattern(i) ^ 0x5A)) return 24;
+    }
+    char msg[128];
+    snprintf(msg, sizeof(msg), "client verified %ld bytes at t=%lld", nbytes,
+             (long long)a->time_ns(c));
+    a->log_msg(c, msg);
+    free(buf);
+    return 0;
+}
+
+int shim_main(const ShimAPI* a, int argc, char** argv) {
+    if (argc >= 3 && strcmp(argv[1], "server") == 0) {
+        return run_server(a, atoi(argv[2]), argc > 3 ? atol(argv[3]) : 4096);
+    }
+    if (argc >= 4 && strcmp(argv[1], "client") == 0) {
+        return run_client(a, argv[2], atoi(argv[3]),
+                          argc > 4 ? atol(argv[4]) : 4096);
+    }
+    return 2;
+}

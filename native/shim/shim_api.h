@@ -1,0 +1,54 @@
+/* shim_api.h — the syscall surface virtual processes are written against.
+ *
+ * This is the TPU-era first slice of the reference's interposition stack:
+ * where Shadow preloads ~230 libc symbols in front of unmodified binaries
+ * (reference: src/preload/preload_defs.h:10-375, interposer.c:37-135) and
+ * pumps them on green threads (src/external/rpth/pth_lib.c:95-146,
+ * src/main/host/process.c:1197-1257 process_continue), this runtime runs
+ * plugin code on cooperative ucontext threads against an explicit syscall
+ * vtable. A plugin is a shared object exporting
+ *
+ *     int shim_main(const ShimAPI* api, int argc, char** argv);
+ *
+ * Every api->* call may suspend the calling green thread until the device
+ * simulation advances (window-batched exchange, SURVEY.md §7 step 6b).
+ * Times are virtual nanoseconds from the simulated clock, never the wall
+ * clock (process_emu time family semantics, process.c).
+ */
+#ifndef SHIM_API_H
+#define SHIM_API_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct ShimAPI {
+    /* opaque runtime context, passed back through every call */
+    void* ctx;
+
+    /* socket lifecycle (host.c:773-1110 syscall backend semantics) */
+    int (*sock_socket)(void* ctx);
+    int (*sock_listen)(void* ctx, int fd, int port);
+    int (*sock_accept)(void* ctx, int fd);               /* blocks */
+    int (*sock_connect)(void* ctx, int fd, const char* host, int port); /* blocks */
+    int64_t (*sock_send)(void* ctx, int fd, const void* buf, int64_t n);
+    int64_t (*sock_recv)(void* ctx, int fd, void* buf, int64_t cap); /* blocks; 0 = EOF */
+    int (*sock_close)(void* ctx, int fd);
+
+    /* virtual time (worker_getCurrentTime semantics, worker.c:385-390) */
+    int64_t (*time_ns)(void* ctx);
+    int (*sleep_ns)(void* ctx, int64_t ns);              /* blocks */
+
+    /* simtime-tagged logging through the runtime */
+    void (*log_msg)(void* ctx, const char* msg);
+} ShimAPI;
+
+typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SHIM_API_H */

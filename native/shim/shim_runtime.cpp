@@ -1,0 +1,517 @@
+/* shim_runtime.cpp — green-thread process runtime for virtual hosts.
+ *
+ * The native tier of the framework's real-binary execution slice: the
+ * role the reference splits across rpth (per-process cooperative
+ * schedulers, src/external/rpth/pth_lib.c:95-146), process.c's pump loop
+ * (process_continue, process.c:1197-1257) and the interposer boundary
+ * (src/preload/interposer.c). One runtime instance hosts many virtual
+ * processes; each is a ucontext green thread running plugin code loaded
+ * with dlmopen (fresh linker namespace when available — the elf-loader's
+ * isolated-globals trick, src/external/elf-loader/README:25-33 — falling
+ * back to plain dlopen when glibc's namespace budget runs out).
+ *
+ * The driver (Python, via ctypes) calls shim_pump() once per conservative
+ * window: completions in (connects established, accepts, timer wakes),
+ * green threads run until every one blocks, syscall requests come out.
+ * Payload BYTES live entirely on this side — per-fd byte streams — while
+ * the device simulation carries only metadata/lengths; shim_wire_deliver
+ * moves bytes between endpoints when the simulated TCP reports delivery
+ * (the same payload-off-device split the reference uses between Payload
+ * refs and packet headers, packet.c:40-63).
+ *
+ * Single-threaded by design: green threads are cooperative and the driver
+ * serializes pumps, so no locks anywhere (the determinism discipline of
+ * SURVEY.md §5 applied to the native tier).
+ */
+
+#include "shim_api.h"
+
+#include <dlfcn.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <ucontext.h>
+
+#include <deque>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t kStackSize = 512 * 1024;
+constexpr int kFirstFd = 3;
+
+enum ReqOp : int32_t {
+    REQ_LISTEN = 1,
+    REQ_CONNECT = 2,
+    REQ_SEND = 3,
+    REQ_CLOSE = 4,
+    REQ_SLEEP = 5,
+    REQ_EXIT = 6,
+    REQ_LOG = 7,
+};
+
+enum CompOp : int32_t {
+    COMP_CONNECT_OK = 1,
+    COMP_CONNECT_FAIL = 2,
+    COMP_ACCEPT = 3, /* r0 = new fd (driver-chosen) */
+    COMP_WAKE = 4,
+};
+
+enum BlockKind : int32_t {
+    BLK_NONE = 0,
+    BLK_CONNECT = 1,
+    BLK_ACCEPT = 2,
+    BLK_RECV = 3,
+    BLK_SLEEP = 4,
+};
+
+} // namespace
+
+extern "C" {
+
+/* C ABI mirrored by ctypes in shadow_tpu/proc/native.py */
+struct ShimReq {
+    int32_t pid;
+    int32_t op;
+    int32_t fd;
+    int32_t port;
+    int64_t a0;
+    char name[64];
+};
+
+struct ShimComp {
+    int32_t pid;
+    int32_t op;
+    int32_t fd;
+    int32_t pad;
+    int64_t r0;
+};
+
+} // extern "C"
+
+namespace {
+
+struct Endpoint {
+    std::string inbuf;   /* bytes delivered by the simulated network */
+    std::string outbuf;  /* bytes written by the app, awaiting delivery */
+    std::deque<int> accept_queue; /* listener: driver-assigned child fds */
+    bool fin_rx = false;
+    bool closed = false;
+    bool listening = false;
+};
+
+struct Proc {
+    int32_t pid = -1;
+    int32_t host = -1;
+    ucontext_t ctx{};
+    ucontext_t sched_ctx{};
+    char* stack = nullptr;
+    bool started = false;
+    bool done = false;
+    int exit_code = 0;
+
+    int32_t blocked_on = BLK_NONE;
+    int32_t block_fd = -1;
+    int64_t block_n = 0;
+    void* block_buf = nullptr;
+    int64_t block_result = 0;
+    bool comp_ready = false;
+
+    std::map<int, Endpoint> fds;
+    int next_fd = kFirstFd;
+
+    void* dl = nullptr;
+    shim_main_fn entry = nullptr;
+    std::vector<std::string> argv_store;
+    std::vector<char*> argv;
+};
+
+struct Runtime {
+    std::vector<Proc*> procs;
+    std::vector<ShimReq> reqs;
+    int64_t now_ns = 0;
+    Proc* current = nullptr;
+    long lmid = 0; /* next dlmopen namespace; -1 = exhausted, use dlopen */
+    std::string err;
+};
+
+thread_local Runtime* g_rt = nullptr;
+
+void push_req(Runtime* rt, int32_t pid, int32_t op, int32_t fd, int32_t port,
+              int64_t a0, const char* name) {
+    ShimReq r{};
+    r.pid = pid;
+    r.op = op;
+    r.fd = fd;
+    r.port = port;
+    r.a0 = a0;
+    if (name) {
+        snprintf(r.name, sizeof(r.name), "%s", name);
+    }
+    rt->reqs.push_back(r);
+}
+
+/* suspend the calling green thread until the scheduler resumes it */
+void block_here(Runtime* rt, Proc* p, int32_t kind, int32_t fd, int64_t n,
+                void* buf) {
+    p->blocked_on = kind;
+    p->block_fd = fd;
+    p->block_n = n;
+    p->block_buf = buf;
+    p->comp_ready = false;
+    swapcontext(&p->ctx, &p->sched_ctx);
+}
+
+/* ------------------------------------------------------------------ api */
+
+int api_socket(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    int fd = p->next_fd++;
+    p->fds[fd]; /* default-construct the endpoint */
+    return fd;
+}
+
+int api_listen(void* vctx, int fd, int port) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    it->second.listening = true;
+    push_req(rt, p->pid, REQ_LISTEN, fd, port, 0, nullptr);
+    return 0;
+}
+
+int api_accept(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.listening) return -1;
+    while (it->second.accept_queue.empty()) {
+        block_here(rt, p, BLK_ACCEPT, fd, 0, nullptr);
+        it = p->fds.find(fd);
+        if (it == p->fds.end()) return -1;
+    }
+    int child = it->second.accept_queue.front();
+    it->second.accept_queue.pop_front();
+    return child;
+}
+
+int api_connect(void* vctx, int fd, const char* host, int port) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    if (p->fds.find(fd) == p->fds.end()) return -1;
+    push_req(rt, p->pid, REQ_CONNECT, fd, port, 0, host);
+    block_here(rt, p, BLK_CONNECT, fd, 0, nullptr);
+    return static_cast<int>(p->block_result); /* 0 ok, -1 refused */
+}
+
+int64_t api_send(void* vctx, int fd, const void* buf, int64_t n) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || it->second.closed || n < 0) return -1;
+    it->second.outbuf.append(static_cast<const char*>(buf),
+                             static_cast<size_t>(n));
+    push_req(rt, p->pid, REQ_SEND, fd, 0, n, nullptr);
+    return n;
+}
+
+int64_t api_recv(void* vctx, int fd, void* buf, int64_t cap) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || cap < 0) return -1;
+    while (it->second.inbuf.empty() && !it->second.fin_rx) {
+        block_here(rt, p, BLK_RECV, fd, cap, buf);
+        it = p->fds.find(fd);
+        if (it == p->fds.end()) return -1;
+    }
+    if (it->second.inbuf.empty()) return 0; /* FIN drained: EOF */
+    int64_t n = static_cast<int64_t>(it->second.inbuf.size());
+    if (n > cap) n = cap;
+    memcpy(buf, it->second.inbuf.data(), static_cast<size_t>(n));
+    it->second.inbuf.erase(0, static_cast<size_t>(n));
+    return n;
+}
+
+int api_close(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    it->second.closed = true;
+    push_req(rt, p->pid, REQ_CLOSE, fd, 0, 0, nullptr);
+    return 0;
+}
+
+int64_t api_time_ns(void* vctx) {
+    return static_cast<Runtime*>(vctx)->now_ns;
+}
+
+int api_sleep_ns(void* vctx, int64_t ns) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    if (ns <= 0) return 0;
+    push_req(rt, p->pid, REQ_SLEEP, -1, 0, rt->now_ns + ns, nullptr);
+    block_here(rt, p, BLK_SLEEP, -1, 0, nullptr);
+    return 0;
+}
+
+void api_log(void* vctx, const char* msg) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    push_req(rt, rt->current->pid, REQ_LOG, -1, 0, 0, msg);
+}
+
+ShimAPI make_api(Runtime* rt) {
+    ShimAPI a{};
+    a.ctx = rt;
+    a.sock_socket = api_socket;
+    a.sock_listen = api_listen;
+    a.sock_accept = api_accept;
+    a.sock_connect = api_connect;
+    a.sock_send = api_send;
+    a.sock_recv = api_recv;
+    a.sock_close = api_close;
+    a.time_ns = api_time_ns;
+    a.sleep_ns = api_sleep_ns;
+    a.log_msg = api_log;
+    return a;
+}
+
+/* trampoline: ucontext entry can't portably take pointers, so the proc is
+ * handed over via the runtime's `current` */
+void proc_trampoline() {
+    Runtime* rt = g_rt;
+    Proc* p = rt->current;
+    ShimAPI api = make_api(rt);
+    p->exit_code = p->entry(&api, static_cast<int>(p->argv.size()) - 1,
+                            p->argv.data());
+    p->done = true;
+    push_req(rt, p->pid, REQ_EXIT, -1, 0, p->exit_code, nullptr);
+    swapcontext(&p->ctx, &p->sched_ctx);
+}
+
+bool runnable(const Proc* p) {
+    if (p->done || !p->started) return false;
+    switch (p->blocked_on) {
+        case BLK_NONE:
+            return true;
+        case BLK_CONNECT:
+        case BLK_ACCEPT:
+        case BLK_SLEEP:
+            return p->comp_ready;
+        case BLK_RECV: {
+            auto it = p->fds.find(p->block_fd);
+            if (it == p->fds.end()) return true; /* error path */
+            return !it->second.inbuf.empty() || it->second.fin_rx;
+        }
+    }
+    return false;
+}
+
+void resume(Runtime* rt, Proc* p) {
+    p->blocked_on = BLK_NONE;
+    p->comp_ready = false;
+    rt->current = p;
+    swapcontext(&p->sched_ctx, &p->ctx);
+    rt->current = nullptr;
+}
+
+} // namespace
+
+/* ---------------------------------------------------------------- C ABI */
+
+extern "C" {
+
+void* shim_init(void) {
+    Runtime* rt = new Runtime();
+    return rt;
+}
+
+void shim_free(void* vrt) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    for (Proc* p : rt->procs) {
+        free(p->stack);
+        if (p->dl) dlclose(p->dl);
+        delete p;
+    }
+    delete rt;
+}
+
+const char* shim_last_error(void* vrt) {
+    return static_cast<Runtime*>(vrt)->err.c_str();
+}
+
+/* Load a plugin and create its (not yet started) green thread.
+ * argv_packed: '\0'-separated strings, n_args of them (argv[0] = name). */
+int shim_spawn(void* vrt, int host_gid, const char* so_path,
+               const char* argv_packed, int n_args) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    Proc* p = new Proc();
+    p->pid = static_cast<int32_t>(rt->procs.size());
+    p->host = host_gid;
+
+    /* fresh namespace per process when glibc still has one to give
+     * (elf-loader's unlimited-namespace trick, scaled to glibc's ~16) */
+    if (rt->lmid >= 0) {
+        p->dl = dlmopen(LM_ID_NEWLM, so_path, RTLD_NOW | RTLD_LOCAL);
+        if (!p->dl) rt->lmid = -1;
+    }
+    if (!p->dl) {
+        p->dl = dlopen(so_path, RTLD_NOW | RTLD_LOCAL);
+    }
+    if (!p->dl) {
+        rt->err = std::string("dlopen failed: ") + dlerror();
+        delete p;
+        return -1;
+    }
+    p->entry = reinterpret_cast<shim_main_fn>(dlsym(p->dl, "shim_main"));
+    if (!p->entry) {
+        rt->err = "plugin exports no shim_main";
+        dlclose(p->dl);
+        delete p;
+        return -1;
+    }
+
+    const char* cursor = argv_packed;
+    for (int i = 0; i < n_args; i++) {
+        p->argv_store.emplace_back(cursor);
+        cursor += p->argv_store.back().size() + 1;
+    }
+    for (auto& s : p->argv_store) p->argv.push_back(s.data());
+    p->argv.push_back(nullptr);
+
+    p->stack = static_cast<char*>(malloc(kStackSize));
+    getcontext(&p->ctx);
+    p->ctx.uc_stack.ss_sp = p->stack;
+    p->ctx.uc_stack.ss_size = kStackSize;
+    p->ctx.uc_link = nullptr;
+    makecontext(&p->ctx, proc_trampoline, 0);
+
+    rt->procs.push_back(p);
+    return p->pid;
+}
+
+/* Start a spawned process (its shim_main begins at the next pump). */
+int shim_start(void* vrt, int pid) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (pid < 0 || pid >= static_cast<int>(rt->procs.size())) return -1;
+    rt->procs[pid]->started = true;
+    return 0;
+}
+
+/* Apply completions, run every runnable green thread until all block or
+ * finish, return the batch of emitted syscall requests. */
+int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
+              ShimReq* out, int cap) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    g_rt = rt;
+    rt->now_ns = now_ns;
+    rt->reqs.clear();
+
+    for (int i = 0; i < n_comps; i++) {
+        const ShimComp& c = comps[i];
+        if (c.pid < 0 || c.pid >= static_cast<int>(rt->procs.size()))
+            continue;
+        Proc* p = rt->procs[c.pid];
+        switch (c.op) {
+            case COMP_CONNECT_OK:
+            case COMP_CONNECT_FAIL:
+                if (p->blocked_on == BLK_CONNECT && p->block_fd == c.fd) {
+                    p->block_result = (c.op == COMP_CONNECT_OK) ? 0 : -1;
+                    p->comp_ready = true;
+                }
+                break;
+            case COMP_ACCEPT: {
+                int child = static_cast<int>(c.r0);
+                p->fds[child]; /* create the endpoint */
+                if (child >= p->next_fd) p->next_fd = child + 1;
+                auto it = p->fds.find(c.fd);
+                if (it != p->fds.end()) it->second.accept_queue.push_back(child);
+                if (p->blocked_on == BLK_ACCEPT && p->block_fd == c.fd)
+                    p->comp_ready = true;
+                break;
+            }
+            case COMP_WAKE:
+                if (p->blocked_on == BLK_SLEEP) p->comp_ready = true;
+                break;
+        }
+    }
+
+    /* run-to-quiescence: the reference's process_continue pump
+     * (process.c:1226-1229 "pth_yield while READY|NEW threads exist") */
+    bool progressed = true;
+    while (progressed) {
+        progressed = false;
+        for (Proc* p : rt->procs) {
+            if (runnable(p)) {
+                resume(rt, p);
+                progressed = true;
+            }
+        }
+    }
+
+    int n = static_cast<int>(rt->reqs.size());
+    if (n > cap) n = cap;
+    memcpy(out, rt->reqs.data(), sizeof(ShimReq) * static_cast<size_t>(n));
+    return n;
+}
+
+/* Move simulated-TCP-delivered bytes from the source endpoint's out
+ * stream to the destination endpoint's in buffer. Returns bytes moved. */
+int64_t shim_wire_deliver(void* vrt, int src_pid, int src_fd, int dst_pid,
+                          int dst_fd, int64_t n) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (src_pid < 0 || src_pid >= static_cast<int>(rt->procs.size()))
+        return -1;
+    if (dst_pid < 0 || dst_pid >= static_cast<int>(rt->procs.size()))
+        return -1;
+    auto& sfds = rt->procs[src_pid]->fds;
+    auto& dfds = rt->procs[dst_pid]->fds;
+    auto si = sfds.find(src_fd);
+    auto di = dfds.find(dst_fd);
+    if (si == sfds.end() || di == dfds.end()) return -1;
+    int64_t avail = static_cast<int64_t>(si->second.outbuf.size());
+    if (n > avail) n = avail;
+    if (n > 0) {
+        di->second.inbuf.append(si->second.outbuf.data(),
+                                static_cast<size_t>(n));
+        si->second.outbuf.erase(0, static_cast<size_t>(n));
+    }
+    return n;
+}
+
+/* Peer's FIN reached this endpoint: recv returns EOF once drained. */
+int shim_wire_fin(void* vrt, int pid, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (pid < 0 || pid >= static_cast<int>(rt->procs.size())) return -1;
+    auto it = rt->procs[pid]->fds.find(fd);
+    if (it == rt->procs[pid]->fds.end()) return -1;
+    it->second.fin_rx = true;
+    return 0;
+}
+
+/* -1 = running/blocked, otherwise the plugin's exit code. */
+int shim_proc_exit_code(void* vrt, int pid, int* done) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (pid < 0 || pid >= static_cast<int>(rt->procs.size())) return -1;
+    Proc* p = rt->procs[pid];
+    *done = p->done ? 1 : 0;
+    return p->exit_code;
+}
+
+/* Number of green threads that are blocked on anything but a listener
+ * accept (used by the driver to decide whether fast-forward is safe). */
+int shim_n_waiting(void* vrt) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    int n = 0;
+    for (Proc* p : rt->procs)
+        if (p->started && !p->done) n++;
+    return n;
+}
+
+} // extern "C"

@@ -1,0 +1,11 @@
+"""Real-binary execution tier: native green-thread runtime + device bridge.
+
+See native/shim/shim_runtime.cpp (the runtime), proc/native.py (build +
+ctypes bindings), proc/model.py (the device-side command/observation
+model), proc/tier.py (the window-batched syscall exchange loop).
+"""
+
+from shadow_tpu.proc.native import ShimRuntime, build_runtime, compile_plugin
+from shadow_tpu.proc.tier import ProcessTier  # noqa: E402
+
+__all__ = ["ShimRuntime", "build_runtime", "compile_plugin", "ProcessTier"]

@@ -1,0 +1,125 @@
+"""Device-side bridge model for the real-binary process tier.
+
+The counterpart of the reference's host syscall backend (host.c:773-1651):
+the native runtime's syscall *requests* become injected command events
+executed by this model's handler (bind/listen/connect/send/close against
+the device TCP), and the driver *observes* outcomes each window by
+diffing the device socket/TCB tables (established connections, delivered
+byte counts, consumed FINs) into completions for the green threads.
+
+Only metadata runs on device; the payload bytes stay in the native
+runtime's per-fd streams (SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.events import Events
+from shadow_tpu.host.sockets import PROTO_TCP
+from shadow_tpu.transport.stack import F_FIN, N_PKT_ARGS
+from shadow_tpu.transport.tcp import LISTEN as TCP_LISTEN
+from shadow_tpu.transport.tcp import emit_concat
+
+_I32 = jnp.int32
+
+# command words (args[0] of an injected KIND_CMD event)
+CMD_LISTEN = 1   # args: [cmd, slot, port]
+CMD_CONNECT = 2  # args: [cmd, slot, sport, peer_gid, peer_port]
+CMD_SEND = 3     # args: [cmd, slot, nbytes]
+CMD_CLOSE = 4    # args: [cmd, slot]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ProcApp:
+    """Per-host observation state ([H] / [H, S])."""
+
+    gid: jax.Array  # i32[H]
+    fin_seen: jax.Array  # bool[H, S] — stream EOF consumed per socket
+
+
+class ProcTierModel:
+    """AppModel executing native-process commands on the device stack."""
+
+    name = "shim"
+    needs_tcp = True
+    n_kinds = 1
+
+    def __init__(self):
+        self._stack = None
+        self.kind_cmd = None  # absolute kind index, set by make_handlers
+
+    def app_rows(self) -> int:
+        return 1
+
+    def handler_rows(self) -> int:
+        return 4  # connect(2) + send kick(1) + close kick(1)
+
+    def build(self, b):
+        n = b.n_hosts
+        state = ProcApp(
+            gid=jnp.arange(n, dtype=_I32),
+            fin_seen=jnp.zeros((n, b.n_sockets), bool),
+        )
+        return state, self._make_handlers, self._on_recv
+
+    def _make_handlers(self, stack, kind_base):
+        self._stack = stack
+        self.kind_cmd = kind_base
+        return [self._on_cmd]
+
+    def _on_cmd(self, hs, ev: Events, key):
+        stack, tcp = self._stack, self._stack.tcp
+        cmd = ev.args[0]
+        slot = jnp.maximum(ev.args[1], 0)
+        is_listen = cmd == CMD_LISTEN
+        is_conn = cmd == CMD_CONNECT
+
+        # bind the socket row in-lane (tgen's rebind idiom; host.c bind)
+        sk = hs.net.sockets
+        do_bind = is_listen | is_conn
+        port = ev.args[2]  # listen port / connect source port
+        w = lambda a, v: a.at[slot].set(jnp.where(do_bind, v, a[slot]))
+        sk = dataclasses.replace(
+            sk,
+            proto=w(sk.proto, PROTO_TCP),
+            local_port=w(sk.local_port, port),
+            peer_host=w(sk.peer_host, jnp.where(is_conn, ev.args[3], -1)),
+            peer_port=w(sk.peer_port, jnp.where(is_conn, ev.args[4], 0)),
+        )
+        tcb = hs.net.tcb
+        st_new = tcb.state.at[slot].set(
+            jnp.where(is_listen, TCP_LISTEN, tcb.state[slot])
+        )
+        tcb = dataclasses.replace(tcb, state=st_new)
+        fin_clear = hs.app.fin_seen.at[slot].set(
+            jnp.where(do_bind, False, hs.app.fin_seen[slot])
+        )
+        hs = dataclasses.replace(
+            hs,
+            app=dataclasses.replace(hs.app, fin_seen=fin_clear),
+            net=dataclasses.replace(hs.net, sockets=sk, tcb=tcb),
+        )
+
+        hs, em_conn = tcp.connect(stack, hs, slot, ev.time, mask=is_conn)
+        hs, em_send = tcp.send(
+            hs, slot, ev.args[2], ev.time, mask=cmd == CMD_SEND
+        )
+        hs, em_close = tcp.close(hs, slot, ev.time, mask=cmd == CMD_CLOSE)
+        return hs, emit_concat(em_conn, em_send, em_close)
+
+    def _on_recv(self, hs, slot, pkt, now, key):
+        eof = (slot >= 0) & ((pkt.flags & F_FIN) != 0)
+        s = jnp.maximum(slot, 0)
+        fin = hs.app.fin_seen.at[s].set(
+            jnp.where(eof, True, hs.app.fin_seen[s])
+        )
+        hs = dataclasses.replace(
+            hs, app=dataclasses.replace(hs.app, fin_seen=fin)
+        )
+        return hs, Emit.none(1, N_PKT_ARGS)

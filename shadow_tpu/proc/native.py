@@ -1,0 +1,161 @@
+"""Build + ctypes bindings for the native shim runtime.
+
+The runtime (native/shim/shim_runtime.cpp) is compiled on demand with the
+system toolchain into native/build/ — the framework's equivalent of the
+reference's cmake targets for rpth/elf-loader/preload (they build once
+beside the simulator; here the first ProcessTier use triggers it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SHIM_DIR = os.path.join(_REPO_ROOT, "native", "shim")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+REQ_LISTEN, REQ_CONNECT, REQ_SEND, REQ_CLOSE = 1, 2, 3, 4
+REQ_SLEEP, REQ_EXIT, REQ_LOG = 5, 6, 7
+COMP_CONNECT_OK, COMP_CONNECT_FAIL, COMP_ACCEPT, COMP_WAKE = 1, 2, 3, 4
+
+
+class ShimReq(ctypes.Structure):
+    _fields_ = [
+        ("pid", ctypes.c_int32),
+        ("op", ctypes.c_int32),
+        ("fd", ctypes.c_int32),
+        ("port", ctypes.c_int32),
+        ("a0", ctypes.c_int64),
+        ("name", ctypes.c_char * 64),
+    ]
+
+
+class ShimComp(ctypes.Structure):
+    _fields_ = [
+        ("pid", ctypes.c_int32),
+        ("op", ctypes.c_int32),
+        ("fd", ctypes.c_int32),
+        ("pad", ctypes.c_int32),
+        ("r0", ctypes.c_int64),
+    ]
+
+
+def _compile(sources: list[str], out: str, extra: list[str]) -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in sources
+    ):
+        return out
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", out, *sources,
+           "-I", _SHIM_DIR, "-ldl", *extra]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{' '.join(cmd)}\n{res.stderr}")
+    return out
+
+
+def build_runtime() -> str:
+    """Compile (if stale) and return the runtime .so path."""
+    return _compile(
+        [os.path.join(_SHIM_DIR, "shim_runtime.cpp")],
+        os.path.join(_BUILD_DIR, "libshim_runtime.so"),
+        [],
+    )
+
+
+def compile_plugin(source: str, name: str | None = None) -> str:
+    """Compile a plugin .c/.cpp (exporting shim_main) into native/build."""
+    base = name or os.path.splitext(os.path.basename(source))[0]
+    cc = "g++" if source.endswith(("cc", "cpp")) else "gcc"
+    out = os.path.join(_BUILD_DIR, f"lib{base}.so")
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(source):
+        return out
+    cmd = [cc, "-O2", "-fPIC", "-shared", "-o", out, source, "-I", _SHIM_DIR]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"plugin build failed:\n{' '.join(cmd)}\n{res.stderr}")
+    return out
+
+
+class ShimRuntime:
+    """ctypes wrapper over one runtime instance (a set of virtual
+    processes sharing the driver's pump cadence)."""
+
+    def __init__(self, max_reqs: int = 4096):
+        lib = ctypes.CDLL(build_runtime())
+        lib.shim_init.restype = ctypes.c_void_p
+        lib.shim_free.argtypes = [ctypes.c_void_p]
+        lib.shim_last_error.argtypes = [ctypes.c_void_p]
+        lib.shim_last_error.restype = ctypes.c_char_p
+        lib.shim_spawn.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.shim_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shim_pump.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ShimComp),
+            ctypes.c_int, ctypes.POINTER(ShimReq), ctypes.c_int,
+        ]
+        lib.shim_wire_deliver.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int64,
+        ]
+        lib.shim_wire_deliver.restype = ctypes.c_int64
+        lib.shim_wire_fin.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.shim_proc_exit_code.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ]
+        self._lib = lib
+        self._rt = lib.shim_init()
+        self._req_buf = (ShimReq * max_reqs)()
+        self._max_reqs = max_reqs
+
+    def close(self):
+        if self._rt:
+            self._lib.shim_free(self._rt)
+            self._rt = None
+
+    def spawn(self, host_gid: int, so_path: str, argv: list[str]) -> int:
+        packed = b"\x00".join(a.encode() for a in argv) + b"\x00"
+        pid = self._lib.shim_spawn(
+            self._rt, host_gid, so_path.encode(), packed, len(argv)
+        )
+        if pid < 0:
+            raise RuntimeError(
+                self._lib.shim_last_error(self._rt).decode()
+            )
+        return pid
+
+    def start(self, pid: int) -> None:
+        self._lib.shim_start(self._rt, pid)
+
+    def pump(self, now_ns: int, comps: list[tuple]) -> list[ShimReq]:
+        """comps: [(pid, op, fd, r0)] -> emitted requests."""
+        carr = (ShimComp * max(len(comps), 1))()
+        for i, (pid, op, fd, r0) in enumerate(comps):
+            carr[i].pid, carr[i].op, carr[i].fd, carr[i].r0 = pid, op, fd, r0
+        n = self._lib.shim_pump(
+            self._rt, now_ns, carr, len(comps), self._req_buf, self._max_reqs
+        )
+        return [self._req_buf[i] for i in range(n)]
+
+    def wire_deliver(self, src_pid, src_fd, dst_pid, dst_fd, n) -> int:
+        return int(self._lib.shim_wire_deliver(
+            self._rt, src_pid, src_fd, dst_pid, dst_fd, n
+        ))
+
+    def wire_fin(self, pid, fd) -> None:
+        self._lib.shim_wire_fin(self._rt, pid, fd)
+
+    def exit_code(self, pid: int) -> int | None:
+        done = ctypes.c_int(0)
+        code = self._lib.shim_proc_exit_code(
+            self._rt, pid, ctypes.byref(done)
+        )
+        return int(code) if done.value else None

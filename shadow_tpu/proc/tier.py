@@ -1,0 +1,351 @@
+"""ProcessTier: window-batched syscall exchange between the native
+green-thread runtime and the device simulation.
+
+The reference interleaves plugin execution with simulation events at
+nanosecond granularity (+1ns epoll notify tasks, epoll.c:500-583 →
+process_continue). A TPU cannot afford a host↔device round trip per
+syscall, so this driver batches the exchange at conservative-window
+granularity (SURVEY.md §7 step 6b): once per window it
+
+  1. feeds completions (established connects, accepted children, timer
+     wakes) into `shim_pump`, which runs every runnable green thread
+     until all block again and returns their syscall requests;
+  2. translates requests into command events injected into the device
+     queues (executed by ProcTierModel's handler at the window open);
+  3. steps the simulation one window;
+  4. diffs the device socket/TCB tables: newly-established connections
+     become completions for the next pump, per-socket delivered-byte
+     growth moves real bytes between the native runtime's endpoint
+     streams (shim_wire_deliver), consumed FINs become stream EOFs.
+
+Deviation from the reference, documented for the parity check: process
+reactions land at window boundaries (one lookahead of added latency per
+blocking syscall round trip), and byte-stream content assumes in-order
+delivery — exact on lossless paths, where the device TCP's on-arrival
+accounting is in-order.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import shlex
+from typing import Any
+
+import dataclasses
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config import ShadowConfig, expand_hosts, resolve_path
+from shadow_tpu.core.events import Events, queue_push
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.host.sockets import EPHEMERAL_BASE
+from shadow_tpu.proc.model import (
+    CMD_CLOSE,
+    CMD_CONNECT,
+    CMD_LISTEN,
+    CMD_SEND,
+    ProcTierModel,
+)
+from shadow_tpu.proc.native import (
+    COMP_ACCEPT,
+    COMP_CONNECT_FAIL,
+    COMP_CONNECT_OK,
+    COMP_WAKE,
+    REQ_CLOSE,
+    REQ_CONNECT,
+    REQ_EXIT,
+    REQ_LISTEN,
+    REQ_LOG,
+    REQ_SEND,
+    REQ_SLEEP,
+    ShimRuntime,
+)
+from shadow_tpu.sim import build_simulation
+from shadow_tpu.transport.stack import N_PKT_ARGS
+from shadow_tpu.transport.tcp import CLOSED, ESTABLISHED, SYN_SENT
+
+
+class ProcessTier:
+    """Drives native plugin processes against a config-built simulation.
+
+    Every <process> in the config whose plugin path is a .so exporting
+    `shim_main` runs as a green thread in the native runtime; argv is
+    [basename, *arguments.split()].
+    """
+
+    def __init__(self, cfg: ShadowConfig, *, seed: int = 0,
+                 n_sockets: int = 8, capacity: int = 256,
+                 strict_overflow: bool = True):
+        self.strict_overflow = strict_overflow
+        self.model = ProcTierModel()
+        self.sim = build_simulation(
+            cfg, seed=seed, n_sockets=n_sockets, capacity=capacity,
+            app_model=self.model,
+        )
+        if self.sim.mesh is not None:
+            raise NotImplementedError("ProcessTier is single-shard for now")
+        self.rt = ShimRuntime()
+        self.n_sockets = n_sockets
+        self.logs: list[tuple[int, int, str]] = []  # (sim_ns, pid, msg)
+        self.exit_codes: dict[int, int] = {}
+
+        # (pid, fd) <-> (gid, slot) endpoint maps
+        self.slot_of: dict[tuple[int, int], tuple[int, int]] = {}
+        self.ep_of: dict[tuple[int, int], tuple[int, int]] = {}
+        self.listen_ep: dict[tuple[int, int], tuple[int, int]] = {}
+        self.pending_conn: dict[tuple[int, int], tuple[int, int]] = {}
+        self.wire: dict[tuple[int, int], tuple[int, int]] = {}  # slot<->slot
+        self.undelivered: dict[tuple[int, int], int] = {}
+        self.pid_host: dict[int, int] = {}
+        self._next_slot: dict[int, int] = {}
+        self._next_sport: dict[int, int] = {}
+        self._next_fd: dict[int, int] = {}
+        self._starts: list[tuple[int, int]] = []  # (t_ns, pid) heap
+        self._wakes: list[tuple[int, int]] = []
+        self._pending_comps: list[tuple] = []
+        self._push_jit = jax.jit(queue_push, static_argnames=())
+
+        for h in expand_hosts(cfg):
+            for p in h.spec.processes:
+                spec = cfg.plugin_by_id(p.plugin)
+                path = resolve_path(spec.path, cfg.base_dir) if spec else p.plugin
+                if not (path.endswith(".so") and os.path.exists(path)):
+                    raise ValueError(
+                        "the process tier runs native plugins only: "
+                        f"plugin {p.plugin!r} resolves to {path!r}, which "
+                        "is not an existing .so — configs cannot mix "
+                        "native plugins with modeled ones yet"
+                    )
+                argv = [os.path.basename(path)] + shlex.split(p.arguments)
+                pid = self.rt.spawn(h.gid, path, argv)
+                self.pid_host[pid] = h.gid
+                heapq.heappush(self._starts, (int(p.starttime * SECOND), pid))
+
+        h_n = len(self.sim.names)
+        self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
+        self._prev_fin = np.zeros((h_n, n_sockets), bool)
+
+    # ------------------------------------------------------------- helpers
+    def _alloc_slot(self, gid: int) -> int:
+        # driver-owned slots grow downward from the top; TCP child sockets
+        # allocate first-free from 0 upward, so the ends never collide
+        s = self._next_slot.get(gid, self.n_sockets - 1)
+        self._next_slot[gid] = s - 1
+        if s < 1:
+            raise RuntimeError(f"host {gid}: out of socket slots")
+        return s
+
+    def _alloc_sport(self, gid: int) -> int:
+        p = self._next_sport.get(gid, EPHEMERAL_BASE + 4096)
+        self._next_sport[gid] = p + 1
+        return p
+
+    def _alloc_fd(self, pid: int) -> int:
+        f = self._next_fd.get(pid, 1000)
+        self._next_fd[pid] = f + 1
+        return f
+
+    # ---------------------------------------------------------- translate
+    def _translate(self, reqs, now: int) -> list[tuple[int, list[int]]]:
+        rows: list[tuple[int, list[int]]] = []
+        for r in reqs:
+            pid, fd = int(r.pid), int(r.fd)
+            gid = self.pid_host[pid]
+            if r.op == REQ_LISTEN:
+                slot = self._alloc_slot(gid)
+                self.slot_of[(pid, fd)] = (gid, slot)
+                self.ep_of[(gid, slot)] = (pid, fd)
+                self.listen_ep[(gid, int(r.port))] = (pid, fd)
+                rows.append((gid, [CMD_LISTEN, slot, int(r.port)]))
+            elif r.op == REQ_CONNECT:
+                name = r.name.decode()
+                addr = self.sim.dns.resolve_name(name)
+                if addr is None:
+                    self._pending_comps.append(
+                        (pid, COMP_CONNECT_FAIL, fd, 0)
+                    )
+                    continue
+                slot = self._alloc_slot(gid)
+                sport = self._alloc_sport(gid)
+                self.slot_of[(pid, fd)] = (gid, slot)
+                self.ep_of[(gid, slot)] = (pid, fd)
+                self.pending_conn[(gid, slot)] = (pid, fd)
+                rows.append(
+                    (gid, [CMD_CONNECT, slot, sport, addr.host_id,
+                           int(r.port)])
+                )
+            elif r.op == REQ_SEND:
+                key = (pid, fd)
+                if key in self.slot_of:
+                    gid, slot = self.slot_of[key]
+                    rows.append((gid, [CMD_SEND, slot, int(r.a0)]))
+            elif r.op == REQ_CLOSE:
+                key = (pid, fd)
+                if key in self.slot_of:
+                    gid, slot = self.slot_of[key]
+                    rows.append((gid, [CMD_CLOSE, slot]))
+            elif r.op == REQ_SLEEP:
+                heapq.heappush(self._wakes, (int(r.a0), pid))
+            elif r.op == REQ_LOG:
+                self.logs.append((now, pid, r.name.decode()))
+            elif r.op == REQ_EXIT:
+                self.exit_codes[pid] = int(r.a0)
+        return rows
+
+    # ------------------------------------------------------------- inject
+    def _inject(self, st, rows, now: int):
+        if not rows:
+            return st
+        m = len(rows)
+        cap = 1 << max(m - 1, 0).bit_length()  # pad: bounded recompiles
+        times = np.full((cap,), np.iinfo(np.int64).max, np.int64)
+        dst = np.zeros((cap,), np.int32)
+        seq = np.zeros((cap,), np.int32)
+        kind = np.zeros((cap,), np.int32)
+        argw = np.zeros((cap, N_PKT_ARGS), np.int32)
+        src_seq = np.array(jax.device_get(st.src_seq))
+        for i, (gid, args) in enumerate(rows):
+            times[i] = now
+            dst[i] = gid
+            seq[i] = src_seq[gid]
+            src_seq[gid] += 1
+            kind[i] = self.model.kind_cmd
+            argw[i, : len(args)] = args
+        ev = Events(
+            time=jnp.asarray(times), dst=jnp.asarray(dst),
+            src=jnp.asarray(dst), seq=jnp.asarray(seq),
+            kind=jnp.asarray(kind), args=jnp.asarray(argw),
+        )
+        mask = jnp.asarray(np.arange(cap) < m)
+        q2 = self._push_jit(st.queues, ev, mask, jnp.int32(0))
+        return dataclasses.replace(
+            st, queues=q2, src_seq=jnp.asarray(src_seq)
+        )
+
+    # ------------------------------------------------------------ observe
+    def _observe(self, st) -> None:
+        """Diff device tables into completions + byte/FIN wire ops."""
+        net = st.hosts.net
+        tstate = np.array(jax.device_get(net.tcb.state))
+        rx = np.array(jax.device_get(net.sockets.rx_bytes))
+        fin = np.array(jax.device_get(st.hosts.app.fin_seen))
+        lport = np.array(jax.device_get(net.sockets.local_port))
+        phost = np.array(jax.device_get(net.sockets.peer_host))
+        pport = np.array(jax.device_get(net.sockets.peer_port))
+
+        # pending active opens
+        for key, (pid, fd) in list(self.pending_conn.items()):
+            gid, slot = key
+            s = tstate[gid, slot]
+            if s >= ESTABLISHED:
+                self._pending_comps.append((pid, COMP_CONNECT_OK, fd, 0))
+                del self.pending_conn[key]
+            elif s == CLOSED:
+                self._pending_comps.append((pid, COMP_CONNECT_FAIL, fd, 0))
+                del self.pending_conn[key]
+                del self.ep_of[key]
+                del self.slot_of[(pid, fd)]
+
+        # new child sockets on listening hosts -> accepts
+        for (gid, port), (lpid, lfd) in self.listen_ep.items():
+            for slot in range(tstate.shape[1]):
+                if (gid, slot) in self.ep_of:
+                    continue
+                if tstate[gid, slot] >= ESTABLISHED and \
+                        tstate[gid, slot] != SYN_SENT and \
+                        lport[gid, slot] == port:
+                    nfd = self._alloc_fd(lpid)
+                    self.ep_of[(gid, slot)] = (lpid, nfd)
+                    self.slot_of[(lpid, nfd)] = (gid, slot)
+                    self._pending_comps.append(
+                        (lpid, COMP_ACCEPT, lfd, nfd)
+                    )
+
+        # wire pairing: match endpoints by the (host, port) 4-tuple
+        for key in [k for k in self.ep_of if k not in self.wire]:
+            gid, slot = key
+            peer = (int(phost[gid, slot]), -1)
+            if peer[0] < 0:
+                continue
+            pg = peer[0]
+            for pslot in range(tstate.shape[1]):
+                if (pg, pslot) not in self.ep_of:
+                    continue
+                if (
+                    lport[pg, pslot] == pport[gid, slot]
+                    and phost[pg, pslot] == gid
+                    and pport[pg, pslot] == lport[gid, slot]
+                ):
+                    self.wire[key] = (pg, pslot)
+                    self.wire[(pg, pslot)] = key
+                    break
+
+        # delivered bytes + FIN propagation
+        for key, (pid, fd) in self.ep_of.items():
+            gid, slot = key
+            d = int(rx[gid, slot] - self._prev_rx[gid, slot])
+            if d > 0:
+                self.undelivered[key] = self.undelivered.get(key, 0) + d
+            if self.undelivered.get(key) and key in self.wire:
+                src = self.wire[key]
+                if src in self.ep_of:
+                    spid, sfd = self.ep_of[src]
+                    moved = self.rt.wire_deliver(
+                        spid, sfd, pid, fd, self.undelivered[key]
+                    )
+                    if moved > 0:
+                        self.undelivered[key] -= moved
+            if fin[gid, slot] and not self._prev_fin[gid, slot]:
+                if not self.undelivered.get(key):
+                    self.rt.wire_fin(pid, fd)
+                else:
+                    # bytes still owed; FIN re-checked next window
+                    fin[gid, slot] = False
+
+        self._prev_rx = rx
+        self._prev_fin = fin
+
+    # ---------------------------------------------------------------- run
+    def run(self, stop_s: float | None = None):
+        sim = self.sim
+        stop_ns = int(stop_s * SECOND) if stop_s is not None else sim.stop_ns
+        st = sim.state0
+        now = 0
+        while True:
+            comps = self._pending_comps
+            self._pending_comps = []
+            while self._starts and self._starts[0][0] <= now:
+                _, pid = heapq.heappop(self._starts)
+                self.rt.start(pid)
+            while self._wakes and self._wakes[0][0] <= now:
+                _, pid = heapq.heappop(self._wakes)
+                comps.append((pid, COMP_WAKE, -1, 0))
+
+            reqs = self.rt.pump(now, comps)
+            st = self._inject(st, self._translate(reqs, now), now)
+
+            if now >= stop_ns:
+                break
+            # never step past the next host-side interest point
+            bound = stop_ns
+            if self._starts:
+                bound = min(bound, max(self._starts[0][0], now + 1))
+            if self._wakes:
+                bound = min(bound, max(self._wakes[0][0], now + 1))
+            st = sim.step_window(st, bound)
+            now = int(jax.device_get(st.now))
+            self._observe(st)
+        drops = int(jax.device_get(st.queues.drops.sum()))
+        if drops and self.strict_overflow:
+            raise RuntimeError(
+                f"event queue overflow: {drops} events dropped (capacity "
+                f"{self.sim.engine.cfg.capacity}); native processes may "
+                "have observed a corrupted simulation — rerun with a "
+                "larger capacity"
+            )
+        return st
+
+    def close(self):
+        self.rt.close()
