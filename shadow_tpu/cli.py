@@ -51,6 +51,10 @@ def make_parser() -> argparse.ArgumentParser:
                    help="socket slots per host")
     p.add_argument("--capacity", type=int, default=256,
                    help="event-queue slots per host")
+    p.add_argument("--allow-queue-overflow", action="store_true",
+                   help="count+continue on event-queue overflow instead of "
+                        "failing (the reference's queues are unbounded; "
+                        "overflow here drops the farthest-future events)")
     p.add_argument("--log-level", "-l", default="message",
                    choices=["error", "critical", "warning", "message",
                             "info", "debug"])
@@ -109,6 +113,68 @@ def main(argv=None) -> int:
     if args.bootstrap_end is not None:
         cfg = dataclasses.replace(cfg, bootstraptime=args.bootstrap_end)
 
+    # configs whose plugins are real shared objects run on the process
+    # tier: native green threads + window-batched syscall exchange (the
+    # reference's plugin execution path, process.c)
+    import os
+
+    def _is_shim_plugin(p) -> bool:
+        from shadow_tpu.config import resolve_path
+
+        path = resolve_path(p.path, cfg.base_dir)
+        return path.endswith(".so") and os.path.exists(path)
+
+    if any(_is_shim_plugin(p) for p in cfg.plugins):
+        from shadow_tpu.proc import ProcessTier
+
+        if not all(_is_shim_plugin(p) for p in cfg.plugins):
+            print(
+                "error: configs cannot mix native .so plugins with modeled "
+                "plugins yet; make every plugin a .so or none",
+                file=sys.stderr,
+            )
+            return 2
+        unsupported = []
+        if args.resume:
+            unsupported.append("--resume")
+        if args.checkpoint_interval:
+            unsupported.append("--checkpoint-interval")
+        if args.mesh:
+            unsupported.append("--mesh")
+        if unsupported:
+            print(
+                "error: the process tier (native .so plugins) does not "
+                f"support {', '.join(unsupported)} yet; native endpoint "
+                "streams are not captured in device checkpoints",
+                file=sys.stderr,
+            )
+            return 2
+
+        t0 = time.perf_counter()
+        tier = ProcessTier(
+            cfg, seed=args.seed, n_sockets=args.sockets,
+            capacity=args.capacity,
+            strict_overflow=not args.allow_queue_overflow,
+        )
+        st = tier.run()
+        wall = time.perf_counter() - t0
+        for t_ns, pid, msg in tier.logs:
+            print(f"[{t_ns / SECOND:.6f}] [pid {pid}] {msg}")
+        summary = {
+            "hosts": len(tier.sim.names),
+            "sim_seconds": cfg.stoptime,
+            "wall_seconds": round(wall, 3),
+            "processes": len(tier.pid_host),
+            "exit_codes": tier.exit_codes,
+            "rx_bytes": int(jax.device_get(
+                st.hosts.net.sockets.rx_bytes.sum()
+            )),
+            "queue_drops": int(jax.device_get(st.queues.drops.sum())),
+        }
+        print(json.dumps(summary))
+        tier.close()
+        return 0 if all(c == 0 for c in tier.exit_codes.values()) else 1
+
     t0 = time.perf_counter()
     mesh = None
     if args.mesh:
@@ -119,6 +185,8 @@ def main(argv=None) -> int:
         cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity,
         mesh=mesh,
     )
+    if args.allow_queue_overflow:
+        sim.strict_overflow = False
     n_hosts = len(sim.names)
     print(f"shadow_tpu {__version__}: {n_hosts} hosts, "
           f"{sim.topo.n_vertices} topology vertices, "

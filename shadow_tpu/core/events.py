@@ -173,35 +173,43 @@ def queue_pop(
 ) -> tuple[EventQueue, Events, jax.Array]:
     """Pop, per host, the minimum-(time,src,seq) event with time < `before`.
 
-    Vectorized over all hosts: two masked row reductions (min time, then min
-    tie-break key among slots at that time) and one collision-free scatter to
-    clear the popped slots.
+    Rows carry the sorted-by-key invariant (module docstring), so the
+    minimum is column 0 and popping is a left shift of the popped rows —
+    which *preserves* the invariant, keeping this safe to mix with the
+    engine's prefix reads. (The engine itself drains frontiers in batch
+    via `_drain_window`; this single-pop form serves tests and simple
+    drivers.)
 
-    Returns (queue', events[H], active[H]) where active[h] says host h popped
-    a real event. Inactive rows contain garbage fields (time=TIME_INVALID).
+    Returns (queue', events[H], active[H]) where active[h] says host h
+    popped a real event. Inactive rows contain garbage fields
+    (time=TIME_INVALID).
     """
-    h = q.n_hosts
-    t = q.time
-    min_t = jnp.min(t, axis=1)  # i64[H]
-    is_min = t == min_t[:, None]
-    key2 = jnp.where(is_min, pack_srcseq(q.src, q.seq), jnp.iinfo(jnp.int64).max)
-    slot = jnp.argmin(key2, axis=1)  # i32[H]
-    active = min_t < before
+    active = (q.time[:, 0] < before) & (q.time[:, 0] != TIME_INVALID)
 
-    rows = jnp.arange(h)
-    take = lambda a: a[rows, slot]
     ev = Events(
-        time=jnp.where(active, take(q.time), TIME_INVALID),
+        time=jnp.where(active, q.time[:, 0], TIME_INVALID),
         dst=host_ids.astype(jnp.int32),
-        src=take(q.src),
-        seq=take(q.seq),
-        kind=take(q.kind),
-        args=q.args[rows, slot],
+        src=q.src[:, 0],
+        seq=q.seq[:, 0],
+        kind=q.kind[:, 0],
+        args=q.args[:, 0],
     )
-    new_time = q.time.at[rows, slot].set(
-        jnp.where(active, TIME_INVALID, take(q.time))
+
+    def shift(a, fill):
+        pad = jnp.full_like(a[:, :1], fill)
+        shifted = jnp.concatenate([a[:, 1:], pad], axis=1)
+        m = active.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, shifted, a)
+
+    q2 = dataclasses.replace(
+        q,
+        time=shift(q.time, TIME_INVALID),
+        src=shift(q.src, 0),
+        seq=shift(q.seq, 0),
+        kind=shift(q.kind, 0),
+        args=shift(q.args, 0),
     )
-    return dataclasses.replace(q, time=new_time), ev, active
+    return q2, ev, active
 
 
 def queue_push(
@@ -233,11 +241,12 @@ def queue_push(
        a fixed C + W length, so after the sort a plain reshape yields the
        merged, key-sorted rows. Truncating to C drops the largest keys.
 
-    The 9-word args payload does not ride the sorts; each entry carries
-    its position into a virtual [q.args ; ev.args ; zero] table and args
-    are materialized with a single final gather. The row re-sort also
-    repairs rows whose invariant was broken by the engine's prefix-clear
-    of executed events.
+    Narrow payloads (kind + up to 4 args words, e.g. PHOLD) ride the
+    sorts directly, bit-packed into i64 operand pairs; wider payloads
+    (the 9-word packet args) instead carry a position into a virtual
+    [q.args ; ev.args ; zero] table and are materialized with a single
+    final gather. The row re-sort also repairs rows whose invariant was
+    broken by the engine's prefix-clear of executed events.
     """
     h, c = q.n_hosts, q.capacity
     m = ev.time.shape[0]

@@ -40,3 +40,78 @@ def example_config() -> str:
       arguments="peers=server:8888 sendsize=64KiB recvsize=1MiB count=3 pause=1,2,3"/>
   </host>
 </shadow>"""
+
+
+def tor_example(
+    n_relays_per_class: int = 10,
+    n_clients: int = 950,
+    n_servers: int = 10,
+    filesize: str = "320KiB",
+    count: int = 5,
+    stoptime: int = 60,
+) -> str:
+    """A Tor-like network config (BASELINE.md config 3 shape: minimal Tor
+    with guard/middle/exit classes plus torperf-style clients)."""
+    hosts = []
+    for klass in ("guard", "middle", "exit"):
+        for i in range(n_relays_per_class):
+            hosts.append(
+                f'<host id="{klass}{i}" bandwidthup="102400" '
+                'bandwidthdown="102400">'
+                '<process plugin="tor" starttime="1" arguments="relay"/>'
+                "</host>"
+            )
+    for i in range(n_servers):
+        hosts.append(
+            f'<host id="web{i}" bandwidthup="102400" '
+            'bandwidthdown="102400">'
+            '<process plugin="tor" starttime="1" arguments="server port=80"/>'
+            "</host>"
+        )
+    for i in range(n_clients):
+        hosts.append(
+            f'<host id="torclient{i}">'
+            f'<process plugin="tor" starttime="{3 + (i % 20)}" '
+            f'arguments="client server=web{i % n_servers}:80 '
+            f'filesize={filesize} count={count} pause=1,2,3"/>'
+            "</host>"
+        )
+    return (
+        f'<shadow stoptime="{stoptime}">'
+        f"<topology><![CDATA[{EXAMPLE_TOPOLOGY}]]></topology>"
+        '<plugin id="tor" path="shadow-plugin-tor"/>'
+        + "".join(hosts)
+        + "</shadow>"
+    )
+
+
+def bitcoin_example(
+    n_nodes: int = 5000,
+    blocks: int = 3,
+    blocksize: str = "512KiB",
+    interval: int = 60,
+    stoptime: int | None = None,
+) -> str:
+    """A Bitcoin gossip config (BASELINE.md config 5 shape: N-node P2P
+    block propagation)."""
+    stop = stoptime if stoptime is not None else interval * (blocks + 2)
+    hosts = [
+        '<host id="miner0">'
+        f'<process plugin="bitcoin" starttime="1" arguments="node miner '
+        f'peers=4 blocksize={blocksize} interval={interval} '
+        f'blocks={blocks}"/></host>'
+    ]
+    for i in range(1, n_nodes):
+        hosts.append(
+            f'<host id="btc{i}">'
+            f'<process plugin="bitcoin" starttime="1" arguments="node '
+            f'peers=4 blocksize={blocksize} interval={interval} '
+            f'blocks={blocks}"/></host>'
+        )
+    return (
+        f'<shadow stoptime="{stop}">'
+        f"<topology><![CDATA[{EXAMPLE_TOPOLOGY}]]></topology>"
+        '<plugin id="bitcoin" path="shadow-plugin-bitcoin"/>'
+        + "".join(hosts)
+        + "</shadow>"
+    )

@@ -1,0 +1,354 @@
+"""Bitcoin-like block gossip: INV/GETDATA/BLOCK over a random peer graph.
+
+BASELINE.md config 5 is a 5k-node Bitcoin P2P gossip network measuring
+block propagation (the reference runs real bitcoind via
+shadow-plugin-bitcoin). This jitted model reproduces that workload's
+traffic pattern: a static random peer graph of persistent TCP links,
+miners announcing sequentially-numbered blocks at an interval, and the
+classic three-step relay — INV announce → GETDATA request → block body —
+with duplicate suppression by each node's best-known block.
+
+Deviations (documented for the parity check): INV/GETDATA control
+messages ride small UDP datagrams whose aux word carries
+(type << 24 | block id) — the device TCP moves byte counts, not app
+payloads, so control goes out-of-band while the ~1MiB block *bodies* flow
+through the persistent TCP connections (where congestion/queueing
+matters). Each peer pair shares exactly one TCP link (dialed by the
+lower gid), and a node downloads one block at a time.
+
+Arguments per <process>:
+  node [miner] [peers=4] [blocksize=1MiB] [interval=600] [blocks=10]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as pyrandom
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config import parse_kv_arguments, parse_size
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.events import Events
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.host.sockets import PROTO_TCP, PROTO_UDP
+from shadow_tpu.transport.stack import N_PKT_ARGS
+from shadow_tpu.transport.tcp import ESTABLISHED, emit_concat
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+GOSSIP_PORT = 8333   # UDP control plane
+LINK_PORT = 8334     # TCP block-body links
+INV_BYTES = 61       # wire sizes of the real messages (approx)
+GETDATA_BYTES = 61
+
+T_INV = 1
+T_GETDATA = 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BtcApp:
+    gid: jax.Array  # i32
+    is_node: jax.Array  # bool
+    best: jax.Array  # i32 highest fully-received block (0 = genesis)
+    curr_dl: jax.Array  # i32 block id being downloaded (-1)
+    pending: jax.Array  # i32[S] block id expected on this TCP slot (-1)
+    target: jax.Array  # i64[S] dl_rx threshold that completes it
+    dl_rx: jax.Array  # i64[S] cumulative TCP bytes delivered per slot
+    t_best: jax.Array  # i64 sim time `best` was reached (propagation metric)
+
+
+class BitcoinModel:
+    name = "bitcoin"
+    needs_tcp = True
+    n_kinds = 2  # KIND_DIAL (link setup), KIND_MINE (miner tick)
+
+    MAX_PEERS = 6
+
+    def __init__(self):
+        self._stack = None
+        self._kind_dial = None
+        self._kind_mine = None
+
+    def app_rows(self) -> int:
+        # completion announce: INV to every peer; or GETDATA reply; union
+        return self.MAX_PEERS
+
+    def handler_rows(self) -> int:
+        # dial: connect(2) x outbound links is sequenced one per event;
+        # mine: INV fanout + next tick
+        return self.MAX_PEERS + 1
+
+    # ------------------------------------------------------------- build
+    def build(self, b):
+        n = b.n_hosts
+        is_node = np.zeros((n,), bool)
+        miner = np.zeros((n,), bool)
+        kpeers = np.full((n,), 4, np.int32)
+        blocksize = 1 << 20
+        interval_s = 600.0
+        n_blocks = 10
+
+        for h in b.hosts:
+            for proc in h.spec.processes:
+                kv = parse_kv_arguments(proc.arguments)
+                if "node" not in kv:
+                    raise ValueError(
+                        f"bitcoin process on {h.name!r}: arguments must "
+                        "include 'node'"
+                    )
+                is_node[h.gid] = True
+                miner[h.gid] = "miner" in kv
+                kpeers[h.gid] = min(int(kv.get("peers", 4)), self.MAX_PEERS)
+                if "blocksize" in kv:
+                    blocksize = parse_size(kv["blocksize"])
+                if "interval" in kv:
+                    interval_s = float(kv["interval"])
+                if "blocks" in kv:
+                    n_blocks = int(kv["blocks"])
+                # UDP control socket + TCP link listener
+                b.sockets = b.sockets.bind(h.gid, 0, PROTO_UDP, GOSSIP_PORT)
+                b.sockets = b.sockets.bind(h.gid, 1, PROTO_TCP, LINK_PORT)
+                b.tcb = b.tcb.listen(h.gid, 1)
+                b.add_start_event(h.gid, proc.starttime, 0)  # dial links
+                if miner[h.gid]:
+                    b.add_start_event(
+                        h.gid, proc.starttime + interval_s, 1
+                    )
+
+        # deterministic random peer graph; each undirected edge is dialed
+        # by its lower-gid endpoint so every pair shares exactly one link
+        nodes = np.nonzero(is_node)[0]
+        rng = pyrandom.Random(0xB17C)
+        edges: set[tuple[int, int]] = set()
+        for g in nodes:
+            want = int(kpeers[g])
+            tries = 0
+            while (
+                sum(1 for e in edges if g in e) < want
+                and tries < 10 * want
+                and len(nodes) > 1
+            ):
+                p = int(rng.choice(nodes))
+                tries += 1
+                if p != g:
+                    edges.add((min(g, p), max(g, p)))
+
+        peers = np.full((n, self.MAX_PEERS), -1, np.int32)
+        dials = np.full((n, self.MAX_PEERS), -1, np.int32)
+        deg = np.zeros((n,), np.int32)
+        ndial = np.zeros((n,), np.int32)
+        for a, c in sorted(edges):
+            # keep an edge only if both endpoints have capacity, so the
+            # peer lists and the dialed links describe the same graph
+            if deg[a] >= self.MAX_PEERS or deg[c] >= self.MAX_PEERS:
+                continue
+            peers[a, deg[a]] = c
+            peers[c, deg[c]] = a
+            deg[a] += 1
+            deg[c] += 1
+            dials[a, ndial[a]] = c
+            ndial[a] += 1
+
+        self._g = dict(
+            peers=jnp.asarray(peers),
+            n_peers=jnp.asarray(deg),
+            dials=jnp.asarray(dials),
+            n_dials=jnp.asarray(ndial),
+            blocksize=jnp.int64(blocksize),
+            interval_ns=jnp.int64(int(interval_s * SECOND)),
+            n_blocks=jnp.int32(n_blocks),
+            miner=jnp.asarray(miner),
+        )
+
+        s = b.n_sockets
+        state = BtcApp(
+            gid=jnp.arange(n, dtype=_I32),
+            is_node=jnp.asarray(is_node),
+            best=jnp.zeros((n,), _I32),
+            curr_dl=jnp.full((n,), -1, _I32),
+            pending=jnp.full((n, s), -1, _I32),
+            target=jnp.zeros((n, s), _I64),
+            dl_rx=jnp.zeros((n, s), _I64),
+            t_best=jnp.zeros((n,), _I64),
+        )
+        return state, self._make_handlers, self._on_recv
+
+    def _make_handlers(self, stack, kind_base):
+        self._stack = stack
+        self._kind_dial = kind_base
+        self._kind_mine = kind_base + 1
+        return [self._on_dial, self._on_mine]
+
+    # ---------------------------------------------------------- link setup
+    def _on_dial(self, hs, ev: Events, key):
+        """Dial one outbound link per event, chaining until all are up.
+
+        args[0] = dial index. Out slot for dial i = S-1-i (children fill
+        from low slots; slot 0/1 are the UDP socket and the listener).
+        """
+        stack, tcp, g = self._stack, self._stack.tcp, self._g
+        app: BtcApp = hs.app
+        me = app.gid
+        i = ev.args[0]
+        nd = g["n_dials"][me]
+        ok = app.is_node & (i < nd)
+        peer = g["dials"][me, jnp.clip(i, 0, self.MAX_PEERS - 1)]
+
+        s = hs.app.pending.shape[0]
+        out_slot = s - 1 - jnp.clip(i, 0, self.MAX_PEERS - 1)
+        sk = hs.net.sockets
+        w = lambda a, v: a.at[out_slot].set(jnp.where(ok, v, a[out_slot]))
+        sk = dataclasses.replace(
+            sk,
+            proto=w(sk.proto, PROTO_TCP),
+            local_port=w(sk.local_port, LINK_PORT + 1 + i),
+            peer_host=w(sk.peer_host, jnp.maximum(peer, 0)),
+            peer_port=w(sk.peer_port, LINK_PORT),
+        )
+        hs = dataclasses.replace(hs, net=dataclasses.replace(hs.net, sockets=sk))
+        hs, em_conn = tcp.connect(stack, hs, out_slot, ev.time, mask=ok)
+        em_next = Emit.single(
+            dst=0, dt=10_000_000, kind=self._kind_dial,
+            args=[i + 1], mask=ok & (i + 1 < nd), local=True,
+            n_args=N_PKT_ARGS,
+        )
+        return hs, emit_concat(em_conn, em_next)
+
+    # ------------------------------------------------------------- mining
+    def _on_mine(self, hs, ev: Events, key):
+        """Miner tick: adopt a new block, announce INV to all peers."""
+        g = self._g
+        app: BtcApp = hs.app
+        me = app.gid
+        mine = g["miner"][me] & (app.best < g["n_blocks"])
+        new_best = app.best + mine.astype(_I32)
+        app = dataclasses.replace(
+            app,
+            best=new_best,
+            t_best=jnp.where(mine, ev.time, app.t_best),
+        )
+        hs = dataclasses.replace(hs, app=app)
+        hs, em_inv = self._announce(hs, new_best, ev.time, mine)
+        em_next = Emit.single(
+            dst=0, dt=g["interval_ns"], kind=self._kind_mine,
+            mask=mine & (new_best < g["n_blocks"]), local=True,
+            n_args=N_PKT_ARGS,
+        )
+        return hs, emit_concat(em_inv, em_next)
+
+    def _announce(self, hs, block_id, now, mask):
+        """INV(block_id) to every peer (UDP fanout)."""
+        g = self._g
+        me = hs.app.gid
+        ems = []
+        for j in range(self.MAX_PEERS):
+            peer = g["peers"][me, j]
+            m = mask & (peer >= 0)
+            hs, em = self._stack.send_udp(
+                hs, now, 0, jnp.maximum(peer, 0), GOSSIP_PORT, INV_BYTES,
+                aux=(T_INV << 24) | block_id, mask=m,
+            )
+            ems.append(em)
+        return hs, emit_concat(*ems)
+
+    # ---------------------------------------------------------- deliveries
+    def _on_recv(self, hs, slot, pkt, now, key):
+        stack, tcp, g = self._stack, self._stack.tcp, self._g
+        app: BtcApp = hs.app
+        me = app.gid
+        got = (slot >= 0) & app.is_node
+        s = jnp.maximum(slot, 0)
+        is_udp = got & (pkt.proto == PROTO_UDP)
+        mtype = pkt.aux >> 24
+        mblock = pkt.aux & 0xFFFFFF
+
+        # find the single TCP link shared with a given peer
+        def link_slot(peer):
+            sk = hs.net.sockets
+            match = (
+                (sk.proto == PROTO_TCP)
+                & (sk.peer_host == peer)
+                & (hs.net.tcb.state >= ESTABLISHED)
+            )
+            return jnp.where(
+                jnp.any(match), jnp.argmax(match).astype(_I32), -1
+            )
+
+        # -- INV: request the block if it's news and we're idle
+        want = (
+            is_udp & (mtype == T_INV) & (mblock > app.best)
+            & (app.curr_dl < 0)
+        )
+        lslot = link_slot(pkt.src_host)
+        want &= lslot >= 0  # link not up yet: a later INV will retry
+        hs2, em_get = stack.send_udp(
+            hs, now, 0, pkt.src_host, GOSSIP_PORT, GETDATA_BYTES,
+            aux=(T_GETDATA << 24) | mblock, mask=want,
+        )
+        app = hs2.app
+        ls = jnp.maximum(lslot, 0)
+        app = dataclasses.replace(
+            app,
+            curr_dl=jnp.where(want, mblock, app.curr_dl),
+            pending=app.pending.at[ls].set(
+                jnp.where(want, mblock, app.pending[ls])
+            ),
+            target=app.target.at[ls].set(
+                jnp.where(
+                    want, app.dl_rx[ls] + g["blocksize"], app.target[ls]
+                )
+            ),
+        )
+        hs = dataclasses.replace(hs2, app=app)
+
+        # -- GETDATA: push the block body down the shared TCP link
+        serve = is_udp & (mtype == T_GETDATA) & (mblock <= app.best)
+        sslot = link_slot(pkt.src_host)
+        serve &= sslot >= 0
+        hs, em_body = tcp.send(
+            hs, jnp.maximum(sslot, 0), g["blocksize"], now, mask=serve
+        )
+
+        # -- TCP bytes: accumulate; completion adopts + re-announces
+        is_tcp_data = got & (pkt.proto == PROTO_TCP) & (pkt.length > 0)
+        app = hs.app
+        dl2 = app.dl_rx.at[s].add(
+            jnp.where(is_tcp_data, pkt.length.astype(_I64), 0)
+        )
+        complete = (
+            is_tcp_data & (app.pending[s] >= 0) & (dl2[s] >= app.target[s])
+        )
+        new_best = jnp.where(
+            complete, jnp.maximum(app.best, app.pending[s]), app.best
+        )
+        app = dataclasses.replace(
+            app,
+            dl_rx=dl2,
+            best=new_best,
+            t_best=jnp.where(complete, now, app.t_best),
+            curr_dl=jnp.where(complete, -1, app.curr_dl),
+            pending=app.pending.at[s].set(
+                jnp.where(complete, -1, app.pending[s])
+            ),
+        )
+        hs = dataclasses.replace(hs, app=app)
+        hs, em_inv = self._announce(hs, new_best, now, complete)
+
+        # merge mutually-exclusive row groups (a UDP control delivery and
+        # a TCP data delivery never happen in the same event)
+        em_ctl = emit_concat(em_get, em_body).pad_to(self.MAX_PEERS)
+        merged = jax.tree.map(
+            lambda x, y: jnp.where(
+                jnp.broadcast_to(
+                    is_udp.reshape((1,) + (1,) * (x.ndim - 1)), x.shape
+                ),
+                x, y,
+            ),
+            em_ctl, em_inv.pad_to(self.MAX_PEERS),
+        )
+        return hs, merged

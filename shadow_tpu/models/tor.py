@@ -1,0 +1,371 @@
+"""Tor-like onion-circuit model: multi-hop relayed TCP streams.
+
+The reference's flagship workload is a Tor network (BASELINE.md configs
+3/4: guards/middles/exits + torperf clients, run as real tor binaries via
+shadow-plugin-tor). This jitted model reproduces the *traffic shape* that
+those benchmarks measure — telescoped client→guard→middle→exit→server TCP
+circuits, hop-by-hop relaying with per-hop queueing/CoDel/congestion, and
+torperf-style fixed-size fetches — without Tor's cryptography:
+
+- Circuits are chosen at build time (client i's circuit id is i), and each
+  relay learns a connection's circuit from the *source port*
+  (CIRC_PORT_BASE + circuit id), standing in for the onion-layer EXTEND
+  handshake; hop positions come from a static circuit table instead of
+  decrypted cells. Deviation documented here for the parity check.
+- A client opens one circuit connection, sends a REQ_BYTES request cell,
+  and the server answers with `filesize` bytes that flow back through all
+  three hops (torperf's fixed-size downloads). `count` fetches per client
+  with cycling `pause` gaps, tgen-style.
+- Relays are pure byte movers: data arriving on one side of a circuit is
+  re-sent on the other side; EOF propagates as close. This is where the
+  4× traffic amplification (and the realistic relay load) comes from.
+
+Arguments per <process>:
+  relay    [port=9001]                     — onion relay (any position)
+  server   [port=80]                       — destination web server
+  client   server=<name>[:port] filesize=5MiB count=10 pause=1,2
+           [guards=g1,g2 middles=... exits=...]  — explicit relay pools;
+           default pools come from hosts named guard*/middle*/exit*/relay*
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as pyrandom
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config import parse_kv_arguments, parse_size
+from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.events import Events
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP
+from shadow_tpu.transport.stack import F_FIN, N_PKT_ARGS
+from shadow_tpu.transport.tcp import emit_concat
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+OR_PORT = 9001          # default relay listen port
+WEB_PORT = 80           # default server listen port
+CIRC_PORT_BASE = 20_000  # sport CIRC_PORT_BASE+cid identifies the circuit
+REQ_BYTES = 512         # one request "cell" (Tor's cell size)
+
+ROLE_NONE, ROLE_RELAY, ROLE_CLIENT, ROLE_SERVER = 0, 1, 2, 3
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TorApp:
+    """Per-host state ([H] / [H, S] at rest)."""
+
+    gid: jax.Array  # i32
+    role: jax.Array  # i32
+    fwd: jax.Array  # i32[S] circuit peer slot (-1 = none)
+    req_rx: jax.Array  # i64[S] server: request bytes seen per conn
+    streams_started: jax.Array  # i32 client
+    streams_done: jax.Array  # i32 client
+    conn_rx: jax.Array  # i64 client: reply bytes on the circuit conn
+    t_last_done: jax.Array  # i64
+    relayed_bytes: jax.Array  # i64 relay observability
+
+
+class TorModel:
+    name = "tor"
+    needs_tcp = True
+    n_kinds = 1  # KIND_FETCH: open circuit / issue the next fetch
+
+    def __init__(self):
+        self._stack = None
+        self._kind_fetch = None
+
+    def app_rows(self) -> int:
+        # relay new-circuit: connect(2) + fwd send(1) + close fwd(1);
+        # client: next-fetch event; server: reply send — union is 4
+        return 4
+
+    def handler_rows(self) -> int:
+        return 4  # client fetch: connect(2) + request send(1) + spare
+
+    # ------------------------------------------------------------- build
+    def build(self, b):
+        n = b.n_hosts
+        role = np.zeros((n,), np.int32)
+        pools: dict[str, list[int]] = {
+            "guard": [], "middle": [], "exit": [], "relay": []
+        }
+        clients: list[tuple[int, dict]] = []
+
+        for h in b.hosts:
+            for proc in h.spec.processes:
+                kv = parse_kv_arguments(proc.arguments)
+                # role keyword order matters: a client line carries
+                # `server=<name>` as a key, so "client" is checked first
+                if "client" in kv:
+                    role[h.gid] = ROLE_CLIENT
+                    clients.append((h.gid, kv))
+                    b.add_start_event(h.gid, proc.starttime, 0)
+                elif "relay" in kv:
+                    role[h.gid] = ROLE_RELAY
+                    name = h.name.lower()
+                    for p in ("guard", "middle", "exit"):
+                        if name.startswith(p):
+                            pools[p].append(h.gid)
+                            break
+                    else:
+                        pools["relay"].append(h.gid)
+                    port = int(kv.get("port", OR_PORT))
+                    b.sockets = b.sockets.bind(h.gid, 0, PROTO_TCP, port)
+                    b.tcb = b.tcb.listen(h.gid, 0)
+                elif "server" in kv:
+                    role[h.gid] = ROLE_SERVER
+                    port = int(kv.get("port", WEB_PORT))
+                    b.sockets = b.sockets.bind(h.gid, 0, PROTO_TCP, port)
+                    b.tcb = b.tcb.listen(h.gid, 0)
+                else:
+                    raise ValueError(
+                        f"tor process on {h.name!r} needs a role "
+                        "(relay/server/client)"
+                    )
+
+        # circuit table: client i = circuit i; deterministic selection
+        # (the role the directory consensus plays in real Tor)
+        nc = max(len(clients), 1)
+        hops = np.zeros((nc, 3), np.int32)
+        srv_gid = np.zeros((nc,), np.int32)
+        srv_port = np.full((nc,), WEB_PORT, np.int32)
+        filesize = np.full((nc,), 1 << 20, np.int64)
+        count = np.zeros((nc,), np.int32)
+        pause_ns = np.full((nc, 4), SECOND, np.int64)
+        n_pause = np.ones((nc,), np.int32)
+        client_circ = np.full((n,), -1, np.int32)
+
+        rng = pyrandom.Random(0xC1BC)
+        guards = pools["guard"] or pools["relay"]
+        middles = pools["middle"] or pools["relay"]
+        exits = pools["exit"] or pools["relay"]
+        if clients and not (guards and middles and exits):
+            raise ValueError("tor config has clients but no relays")
+
+        for ci, (gid, kv) in enumerate(clients):
+            client_circ[gid] = ci
+            # distinct relays per circuit (a relay appears in one position)
+            path = None
+            for _ in range(64):
+                cand = (rng.choice(guards), rng.choice(middles),
+                        rng.choice(exits))
+                if len(set(cand)) == 3 or (
+                    len(guards) * len(middles) * len(exits) < 8
+                ):
+                    path = cand
+                    break
+            hops[ci] = path
+            srv = kv.get("server", "")
+            sname, _, sport = srv.partition(":")
+            addr = b.dns.resolve_name(sname) if sname else None
+            if addr is None:
+                raise ValueError(
+                    f"tor client on gid {gid} has unknown server {srv!r}"
+                )
+            srv_gid[ci] = addr.host_id
+            srv_port[ci] = int(sport) if sport else WEB_PORT
+            filesize[ci] = parse_size(kv.get("filesize", "1MiB"))
+            count[ci] = int(kv.get("count", 1))
+            pauses = [
+                float(t) for t in str(kv.get("pause", "1")).split(",") if t
+            ]
+            for j, t in enumerate(pauses[:4]):
+                pause_ns[ci, j] = int(t * SECOND)
+            n_pause[ci] = max(min(len(pauses), 4), 1)
+
+        self._g = dict(
+            hops=jnp.asarray(hops),
+            srv_gid=jnp.asarray(srv_gid),
+            srv_port=jnp.asarray(srv_port),
+            filesize=jnp.asarray(filesize),
+            count=jnp.asarray(count),
+            pause_ns=jnp.asarray(pause_ns),
+            n_pause=jnp.asarray(n_pause),
+            client_circ=jnp.asarray(client_circ),
+            or_port=jnp.int32(OR_PORT),
+        )
+
+        s = b.n_sockets
+        state = TorApp(
+            gid=jnp.arange(n, dtype=_I32),
+            role=jnp.asarray(role),
+            fwd=jnp.full((n, s), -1, _I32),
+            req_rx=jnp.zeros((n, s), _I64),
+            streams_started=jnp.zeros((n,), _I32),
+            streams_done=jnp.zeros((n,), _I32),
+            conn_rx=jnp.zeros((n,), _I64),
+            t_last_done=jnp.zeros((n,), _I64),
+            relayed_bytes=jnp.zeros((n,), _I64),
+        )
+        return state, self._make_handlers, self._on_recv
+
+    def _make_handlers(self, stack, kind_base):
+        self._stack = stack
+        self._kind_fetch = kind_base
+        return [self._on_fetch]
+
+    # ------------------------------------------------- client fetch kind
+    def _on_fetch(self, hs, ev: Events, key):
+        """Open the circuit connection (first fetch) / issue a request."""
+        stack, tcp, g = self._stack, self._stack.tcp, self._g
+        app: TorApp = hs.app
+        me = app.gid
+        cid = g["client_circ"][me]
+        is_client = (app.role == ROLE_CLIENT) & (cid >= 0)
+        ok = is_client & (app.streams_started < g["count"][jnp.maximum(cid, 0)])
+        cidc = jnp.maximum(cid, 0)
+        first = ok & (app.streams_started == 0)
+
+        cs = hs.net.tcb.state.shape[0] - 1  # dedicated circuit slot (top)
+        sk = hs.net.sockets
+        w = lambda a, v: a.at[cs].set(jnp.where(first, v, a[cs]))
+        sk = dataclasses.replace(
+            sk,
+            proto=w(sk.proto, PROTO_TCP),
+            local_port=w(sk.local_port, CIRC_PORT_BASE + cidc),
+            peer_host=w(sk.peer_host, g["hops"][cidc, 0]),
+            peer_port=w(sk.peer_port, g["or_port"]),
+        )
+        app = dataclasses.replace(
+            app, streams_started=app.streams_started + ok.astype(_I32)
+        )
+        hs = dataclasses.replace(
+            hs, app=app, net=dataclasses.replace(hs.net, sockets=sk)
+        )
+        hs, em_conn = tcp.connect(stack, hs, cs, ev.time, mask=first)
+        hs, em_req = tcp.send(hs, cs, REQ_BYTES, ev.time, mask=ok)
+        return hs, emit_concat(em_conn, em_req)
+
+    # -------------------------------------------------------- deliveries
+    def _on_recv(self, hs, slot, pkt, now, key):
+        """Role dispatch on every delivered chunk/EOF."""
+        stack, tcp, g = self._stack, self._stack.tcp, self._g
+        app: TorApp = hs.app
+        me = app.gid
+        got = slot >= 0
+        s = jnp.maximum(slot, 0)
+        eof = got & ((pkt.flags & F_FIN) != 0)
+        dlen = jnp.where(got, pkt.length.astype(_I64), 0)
+
+        # ---------------- relay: forward bytes along the circuit
+        is_relay = got & (app.role == ROLE_RELAY)
+        have_fwd = app.fwd[s] >= 0
+        # new inbound circuit conn: source port encodes the circuit
+        cid = pkt.src_port - CIRC_PORT_BASE
+        new_circ = is_relay & ~have_fwd & (cid >= 0) & (
+            cid < g["hops"].shape[0]
+        )
+        cidc = jnp.clip(cid, 0, g["hops"].shape[0] - 1)
+        hop_row = g["hops"][cidc]
+        my_pos = jnp.argmax(hop_row == me).astype(_I32)  # guard/middle/exit
+        at_exit = my_pos == 2
+        nxt_gid = jnp.where(
+            at_exit, g["srv_gid"][cidc], hop_row[jnp.minimum(my_pos + 1, 2)]
+        )
+        nxt_port = jnp.where(at_exit, g["srv_port"][cidc], g["or_port"])
+
+        # allocate the outbound slot: last free (children fill from 0 up)
+        free = hs.net.sockets.proto == PROTO_NONE
+        ns = free.shape[0]
+        out_slot = (ns - 1 - jnp.argmax(free[::-1])).astype(_I32)
+        can_open = new_circ & jnp.any(free)
+
+        sk = hs.net.sockets
+        w = lambda a, v: a.at[out_slot].set(
+            jnp.where(can_open, v, a[out_slot])
+        )
+        sk = dataclasses.replace(
+            sk,
+            proto=w(sk.proto, PROTO_TCP),
+            local_port=w(sk.local_port, CIRC_PORT_BASE + cidc),
+            peer_host=w(sk.peer_host, nxt_gid),
+            peer_port=w(sk.peer_port, nxt_port),
+        )
+        fwd = app.fwd
+        fwd = fwd.at[s].set(jnp.where(can_open, out_slot, fwd[s]))
+        fwd = fwd.at[jnp.where(can_open, out_slot, s)].set(
+            jnp.where(can_open, s, fwd[jnp.where(can_open, out_slot, s)])
+        )
+        app = dataclasses.replace(
+            app,
+            fwd=fwd,
+            relayed_bytes=app.relayed_bytes
+            + jnp.where(is_relay, dlen, 0),
+        )
+        hs = dataclasses.replace(
+            hs, app=app, net=dataclasses.replace(hs.net, sockets=sk)
+        )
+        hs, em_open = tcp.connect(stack, hs, out_slot, now, mask=can_open)
+
+        fwd_to = hs.app.fwd[s]
+        do_fwd = is_relay & (fwd_to >= 0) & (dlen > 0)
+        hs, em_fwd = tcp.send(hs, fwd_to, dlen, now, mask=do_fwd)
+        do_close = is_relay & (fwd_to >= 0) & eof
+        hs, em_fc = tcp.close(hs, fwd_to, now, mask=do_close)
+
+        # ---------------- server: answer each request cell with filesize
+        app = hs.app
+        is_server = got & (app.role == ROLE_SERVER)
+        scid = jnp.clip(pkt.src_port - CIRC_PORT_BASE, 0,
+                        g["hops"].shape[0] - 1)
+        prev = app.req_rx[s]
+        newr = prev + jnp.where(is_server, dlen, 0)
+        n_req = (newr // REQ_BYTES - prev // REQ_BYTES).astype(_I64)
+        app = dataclasses.replace(
+            app, req_rx=app.req_rx.at[s].set(newr)
+        )
+        hs = dataclasses.replace(hs, app=app)
+        reply = n_req * g["filesize"][scid]
+        hs, em_srv = tcp.send(
+            hs, s, reply, now, mask=is_server & (reply > 0)
+        )
+
+        # ---------------- client: count reply bytes, schedule next fetch
+        app = hs.app
+        ccid = g["client_circ"][me]
+        is_client = got & (app.role == ROLE_CLIENT) & (ccid >= 0)
+        ccidc = jnp.maximum(ccid, 0)
+        rx2 = app.conn_rx + jnp.where(is_client, dlen, 0)
+        done_now = jnp.minimum(
+            (rx2 // jnp.maximum(g["filesize"][ccidc], 1)).astype(_I32),
+            app.streams_started,
+        )
+        newly = is_client & (done_now > app.streams_done)
+        app = dataclasses.replace(
+            app,
+            conn_rx=rx2,
+            streams_done=jnp.where(newly, done_now, app.streams_done),
+            t_last_done=jnp.where(newly, now, app.t_last_done),
+        )
+        hs = dataclasses.replace(hs, app=app)
+        more = newly & (app.streams_done < g["count"][ccidc])
+        pause = g["pause_ns"][
+            ccidc, app.streams_done % jnp.maximum(g["n_pause"][ccidc], 1)
+        ]
+        em_next = Emit.single(
+            dst=0, dt=pause, kind=self._kind_fetch, mask=more, local=True,
+            n_args=N_PKT_ARGS,
+        )
+
+        # rows: open(2 rows) | fwd send + fwd close | server reply | next
+        em_a = emit_concat(em_fwd, em_fc)
+        em_b = emit_concat(em_srv, em_next)
+        # merge mutually-exclusive row groups to stay within 4 rows:
+        # relay rows never coexist with server/client rows on one host
+        merged = jax.tree.map(
+            lambda x, y: jnp.where(
+                jnp.broadcast_to(
+                    is_relay.reshape((1,) + (1,) * (x.ndim - 1)), x.shape
+                ),
+                x, y,
+            ),
+            em_a, em_b,
+        )
+        return hs, emit_concat(em_open, merged)

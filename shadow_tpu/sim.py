@@ -48,6 +48,10 @@ class SimBuild:
     The app reads per-host process specs/arguments, resolves peer names
     through `dns`, binds listen sockets into `sockets`/`tcb`, and appends
     process start events (starttime semantics of the <process> element).
+
+    `hosts` is the subset of hosts the *current* model owns (all hosts in
+    a single-model simulation); per-host arrays must still be sized
+    `n_hosts` = the full host count, indexed by `HostInstance.gid`.
     """
 
     cfg: ShadowConfig
@@ -60,10 +64,12 @@ class SimBuild:
     start_events: list[tuple[int, int, int, list[int]]] = dataclasses.field(
         default_factory=list
     )  # (time_ns, gid, kind_rel, args words)
+    n_total: int = 0  # full host count (len(hosts) when single-model)
+    kind_offset: int = 0  # current model's kind base relative to the apps'
 
     @property
     def n_hosts(self) -> int:
-        return len(self.hosts)
+        return self.n_total or len(self.hosts)
 
     def resolve_gid(self, name: str) -> int:
         addr = self.dns.resolve_name(name)
@@ -74,7 +80,8 @@ class SimBuild:
     def add_start_event(self, gid: int, time_s: float, kind_rel: int,
                         args: list[int] | None = None) -> None:
         self.start_events.append(
-            (int(time_s * SECOND), gid, kind_rel, list(args or []))
+            (int(time_s * SECOND), gid, self.kind_offset + kind_rel,
+             list(args or []))
         )
 
 
@@ -154,17 +161,36 @@ class Simulation:
             )
         )
 
+    strict_overflow: bool = True
+
     def run(self, stop_ns: int | None = None, state=None):
         """Jit-run to the stop time; returns the final EngineState.
 
         The jitted callables are cached on the instance so repeated calls
         (the CLI's heartbeat loop, checkpoint-interval stepping) reuse one
-        compiled executable instead of retracing."""
+        compiled executable instead of retracing.
+
+        Queue overflow is loud by default: the reference's event heaps are
+        unbounded (src/main/utility/priority_queue.c), so silently dropping
+        events on a full fixed-capacity queue would corrupt simulation
+        semantics mid-run. Set strict_overflow=False to accept counted
+        drops instead (they remain visible in queues.drops).
+        """
         if self._jit_run is None:
             object.__setattr__(self, "_jit_run", self._wrap(self.engine.run))
         st = state if state is not None else self.state0
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
-        return self._jit_run(st, stop)
+        out = self._jit_run(st, stop)
+        if self.strict_overflow:
+            drops = int(jax.device_get(out.queues.drops.sum()))
+            if drops > 0:
+                raise RuntimeError(
+                    f"event queue overflow: {drops} events dropped (per-host "
+                    f"capacity {self.engine.cfg.capacity}); rerun with a "
+                    "larger --capacity, or set strict_overflow=False to "
+                    "accept counted drops"
+                )
+        return out
 
     def step_window(self, state, stop_ns: int | None = None):
         if self._jit_step is None:
@@ -175,39 +201,166 @@ class Simulation:
         return self._jit_step(state, stop)
 
 
-def _plugin_key(cfg: ShadowConfig, plugin_id: str) -> str:
-    """Registry key for a plugin: its id, falling back to path basename
-    substring matching (the reference identifies plugins purely by id but
-    test configs name them after their .so, e.g. 'shadow-plugin-test-phold')."""
+def _plugin_tokens(cfg: ShadowConfig, plugin_id: str) -> set[str]:
+    """Registry-matchable name tokens for a plugin: its id plus its path
+    basename, split on separators (the reference identifies plugins purely
+    by id but test configs name them after their .so, e.g.
+    'shadow-plugin-test-phold'). Whole-token matching keeps registry names
+    like 'tor' from matching inside unrelated words ('monitor')."""
+    import re
+
     spec = cfg.plugin_by_id(plugin_id)
     names = [plugin_id] + ([spec.path.rsplit("/", 1)[-1]] if spec else [])
-    return " ".join(names).lower()
+    toks: set[str] = set()
+    for n in names:
+        toks.update(t for t in re.split(r"[^a-z0-9]+", n.lower()) if t)
+    return toks
 
 
-def resolve_app_model(cfg: ShadowConfig, registry: dict[str, Callable]):
-    """Pick the single app model implied by the config's plugins.
+def resolve_app_models(
+    cfg: ShadowConfig, registry: dict[str, Callable], hosts: list[HostInstance]
+):
+    """Map every host's processes to registered app models.
 
-    v1 constraint: one model per simulation (multi-model handler-table
-    fusion is future work); every process's plugin must map to it.
+    Returns [(name, model_instance, owned_host_list)] in first-appearance
+    order. A host whose processes span two different models is rejected
+    (each host's state rows belong to exactly one model).
     """
-    found: dict[str, Callable] = {}
-    for h in cfg.hosts:
-        for p in h.processes:
-            key = _plugin_key(cfg, p.plugin)
-            for regname, factory in registry.items():
-                if regname in key:
-                    found[regname] = factory
+    owner: dict[int, str] = {}
+    order: list[str] = []
+    for h in hosts:
+        for p in h.spec.processes:
+            toks = _plugin_tokens(cfg, p.plugin)
+            for regname in registry:
+                if regname in toks:
                     break
             else:
                 raise ValueError(
                     f"no app model registered for plugin {p.plugin!r} "
                     f"(known: {sorted(registry)})"
                 )
-    if len(found) != 1:
-        raise ValueError(
-            f"config mixes app models {sorted(found)}; v1 supports one"
+            if owner.setdefault(h.gid, regname) != regname:
+                raise ValueError(
+                    f"host {h.name!r} mixes app models "
+                    f"{owner[h.gid]!r} and {regname!r}"
+                )
+            if regname not in order:
+                order.append(regname)
+    return [
+        (name, registry[name](),
+         [h for h in hosts if owner.get(h.gid) == name])
+        for name in order
+    ]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MultiApp:
+    """Fused app state: every sub-model's [H]-leading state side by side,
+    plus the per-host owning-model index for receive dispatch."""
+
+    model_id: jax.Array  # i32[H]
+    subs: tuple
+
+
+class FusedModel:
+    """Handler-table fusion of several app models (lifts the round-1
+    one-model-per-simulation limit).
+
+    Kinds are laid out [stack | model0 kinds | model1 kinds | ...]; each
+    sub-model's handlers run against its own state slice (the rest of the
+    MultiApp rides along untouched), and packet deliveries dispatch to the
+    receiving host's owning model via lax.switch on model_id.
+    """
+
+    def __init__(self, parts):  # [(name, model, owned_hosts)]
+        self.parts = parts
+        self.name = "+".join(name for name, _, _ in parts)
+        self.needs_tcp = any(m.needs_tcp for _, m, _ in parts)
+        self.n_kinds = sum(m.n_kinds for _, m, _ in parts)
+
+    def app_rows(self) -> int:
+        return max(m.app_rows() for _, m, _ in self.parts)
+
+    def handler_rows(self) -> int:
+        return max(m.handler_rows() for _, m, _ in self.parts)
+
+    def build(self, b: SimBuild):
+        n = b.n_hosts
+        model_id = np.zeros((n,), np.int32)
+        subs, makers, recvs = [], [], []
+        offset = 0
+        for i, (name, model, owned) in enumerate(self.parts):
+            for h in owned:
+                model_id[h.gid] = i
+            sub_b_hosts = b.hosts
+            b.hosts = owned
+            b.kind_offset = offset
+            state_i, make_i, recv_i = model.build(b)
+            b.hosts = sub_b_hosts
+            subs.append(state_i)
+            makers.append(make_i)
+            recvs.append(recv_i)
+            offset += model.n_kinds
+        b.kind_offset = 0
+        self._recvs = recvs
+        self._makers = makers
+        state = MultiApp(
+            model_id=jnp.asarray(model_id), subs=tuple(subs)
         )
-    return next(iter(found.values()))()
+        return state, self._make_handlers, self._on_recv
+
+    def _sub_call(self, hs, i, fn, *args):
+        """Run a sub-model callable against its own app-state slice."""
+        hs_sub = dataclasses.replace(hs, app=hs.app.subs[i])
+        out = fn(hs_sub, *args)
+        hs2, em = out
+        new_subs = tuple(
+            hs2.app if j == i else hs.app.subs[j]
+            for j in range(len(hs.app.subs))
+        )
+        hs2 = dataclasses.replace(
+            hs2, app=MultiApp(model_id=hs.app.model_id, subs=new_subs)
+        )
+        return hs2, em
+
+    def _make_handlers(self, stack, kind_base):
+        rows = self.handler_rows()
+        handlers = []
+        offset = kind_base
+        for i, ((name, model, _), make) in enumerate(
+            zip(self.parts, self._makers)
+        ):
+            for fn in make(stack, offset):
+                def wrapped(hs, ev, key, _i=i, _fn=fn):
+                    hs2, em = self._sub_call(hs, _i, _fn, ev, key)
+                    return hs2, em.pad_to(rows)
+                handlers.append(wrapped)
+            offset += model.n_kinds
+        return handlers
+
+    def _on_recv(self, hs, slot, pkt, now, key):
+        rows = self.app_rows()
+        branches = []
+        for i, recv in enumerate(self._recvs):
+            def mk(_i=i, _recv=recv):
+                if _recv is None:
+                    from shadow_tpu.core.engine import Emit
+
+                    return lambda: (
+                        hs, Emit.none(rows, N_PKT_ARGS)
+                    )
+
+                def br():
+                    hs2, em = self._sub_call(
+                        hs, _i, _recv, slot, pkt, now, key
+                    )
+                    return hs2, em.pad_to(rows)
+
+                return br
+            branches.append(mk())
+        idx = jnp.clip(hs.app.model_id, 0, len(branches) - 1)
+        return jax.lax.switch(idx, branches)
 
 
 def build_simulation(
@@ -251,7 +404,11 @@ def build_simulation(
             h.spec.bandwidthdown or vx.bandwidth_down_kib or DEFAULT_BANDWIDTH_KIB
         )
 
-    model = app_model if app_model is not None else resolve_app_model(cfg, registry)
+    if app_model is not None:
+        model = app_model
+    else:
+        parts = resolve_app_models(cfg, registry, hosts)
+        model = parts[0][1] if len(parts) == 1 else FusedModel(parts)
     net = HostNet.create(
         n_hosts, n_sockets, jnp.asarray(bw_up), jnp.asarray(bw_down),
         with_tcp=model.needs_tcp,
@@ -259,7 +416,7 @@ def build_simulation(
 
     b = SimBuild(
         cfg=cfg, hosts=hosts, dns=dns, topo=topo, n_sockets=n_sockets,
-        sockets=net.sockets, tcb=net.tcb,
+        sockets=net.sockets, tcb=net.tcb, n_total=n_hosts,
     )
     app_state, make_handlers, on_recv = model.build(b)
     net = dataclasses.replace(net, sockets=b.sockets, tcb=b.tcb)
@@ -378,7 +535,14 @@ def _hosts_axis() -> str:
 
 
 def default_registry() -> dict[str, Callable]:
-    from shadow_tpu.models.tgen import TGenModel
+    from shadow_tpu.models.bitcoin import BitcoinModel
     from shadow_tpu.models.phold_net import PholdNetModel
+    from shadow_tpu.models.tgen import TGenModel
+    from shadow_tpu.models.tor import TorModel
 
-    return {"tgen": TGenModel, "phold": PholdNetModel}
+    return {
+        "tgen": TGenModel,
+        "phold": PholdNetModel,
+        "tor": TorModel,
+        "bitcoin": BitcoinModel,
+    }

@@ -1,0 +1,78 @@
+"""Multi-model fusion: one config mixing different app models.
+
+Round-1 rejected configs mixing app models (sim.py v1 constraint); the
+reference has no such limit — a Tor config runs tor relays, tor clients
+and tgen servers side by side. FusedModel concatenates handler tables and
+dispatches deliveries by the receiving host's owning model.
+"""
+
+import textwrap
+
+import jax
+import pytest
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.sim import build_simulation
+
+TOPO_1POI = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d3">25.0</data>
+      <data key="d4">0.0</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+MIXED = textwrap.dedent(f"""\
+<shadow stoptime="30">
+  <topology><![CDATA[{TOPO_1POI}]]></topology>
+  <plugin id="tgen" path="~/.shadow/bin/tgen"/>
+  <plugin id="phold" path="~/.shadow/bin/shadow-plugin-test-phold"/>
+  <host id="server">
+    <process plugin="tgen" starttime="1" arguments="server port=8888"/>
+  </host>
+  <host id="client">
+    <process plugin="tgen" starttime="2"
+      arguments="peers=server:8888 sendsize=2KiB recvsize=4KiB count=2 pause=1"/>
+  </host>
+  <host id="peer" quantity="4">
+    <process plugin="phold" starttime="1" arguments="load=3"/>
+  </host>
+</shadow>""")
+
+
+def test_mixed_tgen_phold_runs_both_models():
+    cfg = parse_config(MIXED)
+    sim = build_simulation(cfg, seed=5)
+    assert sim.app.name == "tgen+phold"
+    st = sim.run()
+
+    tgen_state, phold_state = st.hosts.app.subs
+    # tgen pair finished its 2 streams
+    assert int(tgen_state.streams_done[1]) == 2
+    assert int(tgen_state.conn_rx[1]) >= 4096
+    # phold peers kept the message population alive (4 peers x load 3)
+    assert int(phold_state.n_recv[2:].sum()) > 50
+    # models never bled into each other's hosts
+    assert st.hosts.app.model_id.tolist() == [0, 0, 1, 1, 1, 1]
+    assert int(phold_state.n_recv[:2].sum()) == 0
+    assert int(tgen_state.streams_done[2:].sum()) == 0
+
+
+def test_host_mixing_models_rejected():
+    bad = MIXED.replace(
+        '<process plugin="phold" starttime="1" arguments="load=3"/>',
+        '<process plugin="phold" starttime="1" arguments="load=3"/>'
+        '<process plugin="tgen" starttime="2" arguments="server port=1"/>',
+        1,
+    )
+    with pytest.raises(ValueError, match="mixes app models"):
+        build_simulation(parse_config(bad), seed=0)
