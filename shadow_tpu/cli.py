@@ -75,20 +75,31 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _heartbeat_lines(st, names, sim_now_s: float) -> list[str]:
-    """[shadow-heartbeat] [node] per-host CSV — the tracker's format
-    spirit (tracker.c:433-479 'name,rx,tx,...')."""
-    socks = st.hosts.net.sockets
-    rx = jax.device_get(socks.rx_bytes.sum(axis=1))
-    tx = jax.device_get(socks.tx_bytes.sum(axis=1))
-    ev = jax.device_get(st.stats.n_executed)
-    out = []
-    for i, name in enumerate(names):
-        out.append(
-            f"[shadow-heartbeat] [node] {sim_now_s:.0f},{name},"
-            f"{int(rx[i])},{int(tx[i])},{int(ev[i])}"
-        )
-    return out
+def _make_observability(cfg, sim, args):
+    """Logger + tracker honoring the config's per-host loglevel and
+    heartbeatloginfo attrs (tracker.c:433-561; shadow_logger.c:102-121)."""
+    from shadow_tpu.config import expand_hosts
+    from shadow_tpu.utils.logger import ShadowLogger
+    from shadow_tpu.utils.tracker import Tracker
+
+    logger = ShadowLogger(default_level=args.log_level)
+    info_of: dict[str, tuple[str, ...]] = {}
+    level_of: dict[str, str] = {}
+    for h in expand_hosts(cfg):
+        if h.spec.loglevel:
+            logger.set_host_level(h.name, h.spec.loglevel)
+        if h.spec.heartbeatloginfo:
+            info_of[h.name] = tuple(
+                p.strip() for p in h.spec.heartbeatloginfo.split(",")
+                if p.strip()
+            )
+        if h.spec.heartbeatloglevel:
+            level_of[h.name] = h.spec.heartbeatloglevel
+    tracker = Tracker(
+        sim.names, logger, log_info=("node",), info_of=info_of,
+        level_of=level_of,
+    )
+    return logger, tracker
 
 
 def main(argv=None) -> int:
@@ -246,6 +257,7 @@ def main(argv=None) -> int:
     ck = args.checkpoint_interval
     next_hb = (math.floor(sim_s / hb) + 1) * hb if hb > 0 else float("inf")
     next_ckpt = (math.floor(sim_s / ck) + 1) * ck if ck > 0 else float("inf")
+    logger, tracker = _make_observability(cfg, sim, args)
     t1 = time.perf_counter()
     while sim_s < stop_s:
         nxt = min(next_hb, next_ckpt, stop_s)
@@ -253,8 +265,8 @@ def main(argv=None) -> int:
         st.now.block_until_ready()
         sim_s = nxt
         if sim_s >= next_hb:
-            for line in _heartbeat_lines(st, sim.names, sim_s):
-                print(line)
+            tracker.heartbeat(st, int(sim_s * SECOND))
+            logger.flush()
             next_hb += hb
         if sim_s >= next_ckpt:
             from shadow_tpu.utils import save_checkpoint

@@ -149,6 +149,7 @@ class EngineState:
     src_seq: jax.Array  # i32[H] per-source sequence counters
     exec_cnt: jax.Array  # i32[H] per-host executed-event counters (RNG)
     stats: Stats
+    cpu_free: jax.Array  # i64[H] virtual-CPU available-from time
 
 
 # Handler signature: (host_state_slice, ev: Events scalar, key) ->
@@ -197,15 +198,28 @@ def _select_rows(mask: jax.Array, new: Any, old: Any) -> Any:
 class Engine:
     """Builds jittable window-step / run functions over a handler table.
 
-    `network.route(src_gid, dst_gid) -> (latency_ns i64, reliability f32)`
-    supplies the topology model (element-wise over arrays).
+    `network.route(src_gid, dst_gid) -> (latency_ns i64, reliability f32,
+    jitter_ns i64)` supplies the topology model (element-wise over
+    arrays); a truthy `network.has_jitter` enables the per-packet jitter
+    roll.
     """
 
-    def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network):
+    def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network,
+                 cpu_cost=None):
+        """`cpu_cost`: optional i64[H] per-event virtual-CPU nanoseconds
+        (the reference's per-host CPU model delays event execution while
+        the virtual CPU is busy — cpu.c:56-107, event.c:75-84). None or
+        zeros disables the model with no overhead in results."""
         self.cfg = cfg
         self.handlers = tuple(handlers)
         self.network = network
         self._base_key = srng.root_key(cfg.seed)
+        if cpu_cost is None:
+            cpu_cost = jnp.zeros((cfg.n_hosts,), jnp.int64)
+        self.cpu_cost = jnp.asarray(cpu_cost, jnp.int64)
+        # jitter rolls cost an extra uniform per emit row; skip them
+        # entirely for jitter-free networks
+        self._use_jitter = bool(getattr(network, "has_jitter", False))
 
     # -- collectives (identity when unsharded) ------------------------------
     def _gmin(self, x):
@@ -313,6 +327,7 @@ class Engine:
             src_seq=seq0,
             exec_cnt=jnp.zeros((cfg.n_hosts,), jnp.int32),
             stats=Stats.create(cfg.n_hosts),
+            cpu_free=jnp.zeros((cfg.n_hosts,), jnp.int64),
         )
 
     # -- execute one frontier position across all hosts ---------------------
@@ -359,17 +374,36 @@ class Engine:
         is_local = emit.local
         dst = jnp.where(is_local, self_gid, emit.dst)
         dt = jnp.maximum(emit.dt, 0)
-        lat, rel = self.network.route(jnp.broadcast_to(self_gid, (h, k)), dst)
-        t = ev.time[:, None] + dt
-        t_remote = jnp.maximum(t + lat, window_end)
-        t = jnp.where(is_local, t, t_remote)
+        lat, rel, jit = self.network.route(
+            jnp.broadcast_to(self_gid, (h, k)), dst
+        )
 
         def roll(key, kidx):
             return jax.random.uniform(jax.random.fold_in(key, kidx))
 
-        u = jax.vmap(
-            lambda key: jax.vmap(lambda i: roll(key, i))(jnp.arange(k, dtype=jnp.uint32))
-        )(rkeys)
+        def rolls(offset):
+            return jax.vmap(
+                lambda key: jax.vmap(lambda i: roll(key, i))(
+                    jnp.arange(k, dtype=jnp.uint32) + offset
+                )
+            )(rkeys)
+
+        if self._use_jitter:
+            # seeded symmetric latency noise, per packet (the reference
+            # carries per-edge jitter attrs, topology.c:101-105; paths
+            # accumulate them like latency)
+            uj = rolls(jnp.uint32(k))
+            lat = jnp.maximum(
+                lat + ((uj * 2.0 - 1.0) * jit.astype(jnp.float32)).astype(
+                    jnp.int64
+                ),
+                0,
+            )
+        t = ev.time[:, None] + dt
+        t_remote = jnp.maximum(t + lat, window_end)
+        t = jnp.where(is_local, t, t_remote)
+
+        u = rolls(jnp.uint32(0))
         dropped = (~is_local) & (u >= rel) & emask
         final_mask = emask & ~dropped
 
@@ -403,11 +437,14 @@ class Engine:
         i64max = jnp.iinfo(jnp.int64).max
 
         def outer_cond(carry):
-            q = carry[0]
-            return self._gany(jnp.any(q.min_time() < window_end))
+            q, cpu_free = carry[0], carry[5]
+            # a host's next executable instant is its earliest event or,
+            # if later, when its virtual CPU frees up (cpu.c semantics)
+            nxt = jnp.maximum(q.min_time(), cpu_free)
+            return self._gany(jnp.any(nxt < window_end))
 
         def outer_body(carry):
-            q, hosts, src_seq, exec_cnt, stats = carry
+            q, hosts, src_seq, exec_cnt, stats, cpu_free = carry
 
             # frontier extraction: queue rows are sorted by (time, src, seq)
             # with empties last (events.py invariant), so each host's b
@@ -423,19 +460,28 @@ class Engine:
             executed0 = jnp.zeros((b, h), bool)
 
             def inner_cond(ic):
-                bi, _, _, _, _, min_emit, _, _, _ = ic
+                bi, min_emit, cpu_free = ic[0], ic[5], ic[9]
                 col = jax.lax.dynamic_index_in_dim(bt, bi, 1, keepdims=False)
                 vcol = jax.lax.dynamic_index_in_dim(bvalid, bi, 1, keepdims=False)
-                return (bi < b) & jnp.any(vcol & (col < min_emit))
+                runnable = (
+                    vcol & (col < min_emit)
+                    & (jnp.maximum(col, cpu_free) < window_end)
+                )
+                return (bi < b) & jnp.any(runnable)
 
             def inner_body(ic):
                 (bi, hosts, src_seq, exec_cnt, stats, min_emit, ebuf, emask,
-                 executed) = ic
+                 executed, cpu_free) = ic
                 col = lambda a: jax.lax.dynamic_index_in_dim(a, bi, 1, keepdims=False)
                 ev_t = col(bt)
-                active = col(bvalid) & (ev_t < min_emit)
+                # the event runs when both it and the virtual CPU are due;
+                # past the barrier it stays queued for a later window
+                eff_t = jnp.maximum(ev_t, cpu_free)
+                active = (
+                    col(bvalid) & (ev_t < min_emit) & (eff_t < window_end)
+                )
                 ev = Events(
-                    time=jnp.where(active, ev_t, TIME_INVALID),
+                    time=jnp.where(active, eff_t, TIME_INVALID),
                     dst=gids,
                     src=col(bsrc),
                     seq=col(bseq),
@@ -446,20 +492,25 @@ class Engine:
                  local_below) = self._execute_step(
                     hosts, src_seq, exec_cnt, stats, ev, active, window_end, gids
                 )
+                cpu_free = jnp.where(
+                    active & (self.cpu_cost > 0), eff_t + self.cpu_cost,
+                    cpu_free,
+                )
                 upd = lambda buf, x: jax.lax.dynamic_update_index_in_dim(buf, x, bi, 0)
                 ebuf = jax.tree.map(upd, ebuf, out)
                 emask = upd(emask, fmask)
                 executed = upd(executed, active)
                 min_emit = jnp.minimum(min_emit, jnp.min(local_below, axis=1))
                 return (bi + 1, hosts, src_seq, exec_cnt, stats, min_emit,
-                        ebuf, emask, executed)
+                        ebuf, emask, executed, cpu_free)
 
             (_, hosts, src_seq, exec_cnt, stats, _, ebuf, emask,
-             executed) = jax.lax.while_loop(
+             executed, cpu_free) = jax.lax.while_loop(
                 inner_cond,
                 inner_body,
                 (jnp.int32(0), hosts, src_seq, exec_cnt, stats,
-                 jnp.full((h,), i64max, jnp.int64), ebuf, emask0, executed0),
+                 jnp.full((h,), i64max, jnp.int64), ebuf, emask0, executed0,
+                 cpu_free),
             )
 
             # executed frontier positions form a prefix of each row (the
@@ -474,10 +525,11 @@ class Engine:
             q = self._exchange_push(
                 q, ebuf.flatten(), emask.reshape(-1), host0
             )
-            return (q, hosts, src_seq, exec_cnt, stats)
+            return (q, hosts, src_seq, exec_cnt, stats, cpu_free)
 
-        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats)
-        q, hosts, src_seq, exec_cnt, stats = jax.lax.while_loop(
+        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats,
+                 st.cpu_free)
+        q, hosts, src_seq, exec_cnt, stats, cpu_free = jax.lax.while_loop(
             outer_cond, outer_body, carry
         )
         return dataclasses.replace(
@@ -487,11 +539,15 @@ class Engine:
             src_seq=src_seq,
             exec_cnt=exec_cnt,
             stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
+            cpu_free=cpu_free,
         )
 
     def _next_time(self, st: EngineState) -> jax.Array:
-        """Global earliest pending event time (one reduction + one pmin)."""
-        return self._gmin(jnp.min(st.queues.min_time()))
+        """Global earliest executable time (one reduction + one pmin):
+        per host the earliest pending event, deferred to when its virtual
+        CPU frees up (empty queues stay at TIME_INVALID = i64 max)."""
+        nxt = jnp.maximum(st.queues.min_time(), st.cpu_free)
+        return self._gmin(jnp.min(nxt))
 
     def _advance(self, st: EngineState, nxt, stop, host0) -> EngineState:
         """Open the window [nxt, min(nxt+lookahead, stop)) and drain it."""
@@ -546,13 +602,17 @@ class ConstantNetwork:
     src/test/phold/phold.test.shadow.config.xml: one vertex, 50ms self-loop).
     """
 
-    def __init__(self, latency_ns: int, reliability: float = 1.0):
+    def __init__(self, latency_ns: int, reliability: float = 1.0,
+                 jitter_ns: int = 0):
         self.latency_ns = latency_ns
         self.reliability = reliability
+        self.jitter_ns = jitter_ns
+        self.has_jitter = jitter_ns > 0
 
     def route(self, src, dst):
         shape = jnp.broadcast_shapes(src.shape, dst.shape)
         return (
             jnp.full(shape, self.latency_ns, jnp.int64),
             jnp.full(shape, self.reliability, jnp.float32),
+            jnp.full(shape, self.jitter_ns, jnp.int64),
         )

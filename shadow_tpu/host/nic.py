@@ -62,20 +62,25 @@ class NIC:
     free_at: jax.Array  # i64 time the link is next free
     rate: jax.Array  # f32 bytes per ns
     burst_ns: jax.Array  # i64 max idle credit (bucket depth in time)
+    pkts: jax.Array  # i64 packets admitted (tracker wire accounting)
+    wire: jax.Array  # i64 wire bytes admitted (payload + headers)
 
     @staticmethod
     def create(bandwidth_kib, burst_bytes: int = 16 * 1024) -> "NIC":
         rate = kib_per_sec_to_bytes_per_ns(jnp.asarray(bandwidth_kib))
         rate = jnp.maximum(rate, 1e-12).astype(jnp.float32)
         burst = (burst_bytes / rate.astype(jnp.float64)).astype(jnp.int64)
-        return NIC(free_at=jnp.zeros_like(burst), rate=rate, burst_ns=burst)
+        z = jnp.zeros_like(burst)
+        return NIC(free_at=z, rate=rate, burst_ns=burst, pkts=z, wire=z)
 
     def admit(self, t, nbytes, unlimited=False):
         """Serialize `nbytes` starting no earlier than t.
 
         Returns (nic', start_time, finish_time). With `unlimited` (the
         reference's bootstrap mode, network_interface.c:432-434 /
-        worker.c:445-453) the packet passes through instantly.
+        worker.c:445-453) the packet passes through instantly. Wire-level
+        packet/byte counters ride along (the tracker's in/out byte-class
+        splits, tracker.c:433-479 — header bytes = wire - payload).
         """
         t = jnp.asarray(t, jnp.int64)
         free = jnp.maximum(self.free_at, t - self.burst_ns)
@@ -85,7 +90,16 @@ class NIC:
         start = jnp.where(unlimited, t, start)
         finish = jnp.where(unlimited, t, finish)
         new_free = jnp.where(unlimited, self.free_at, finish)
-        return dataclasses.replace(self, free_at=new_free), start, finish
+        return (
+            dataclasses.replace(
+                self,
+                free_at=new_free,
+                pkts=self.pkts + 1,
+                wire=self.wire + jnp.asarray(nbytes, jnp.int64),
+            ),
+            start,
+            finish,
+        )
 
 
 @jax.tree_util.register_dataclass

@@ -80,6 +80,7 @@ class Topology:
         self._index = {v.vid: v.index for v in self.vertices}
         self._attach_rr: dict[tuple, int] = {}  # round-robin cursor per hint class
         self._lat_ms: np.ndarray | None = None
+        self._jit_ms: np.ndarray | None = None
         self._rel: np.ndarray | None = None
 
     # ---------------------------------------------------------------- load
@@ -199,18 +200,21 @@ class Topology:
 
     # ------------------------------------------------- all-pairs matrices
     def _edge_matrices(self):
-        """Dense [V,V] direct-edge latency (ms; inf if absent) and -log
-        reliability matrices. Parallel edges keep the lowest latency."""
+        """Dense [V,V] direct-edge latency (ms; inf if absent), -log
+        reliability, and jitter (ms) matrices. Parallel edges keep the
+        lowest latency."""
         v = self.n_vertices
         lat = np.full((v, v), np.inf)
         neglog = np.zeros((v, v))
-        for u, w, l, loss, _j in self.edges:
+        jit = np.zeros((v, v))
+        for u, w, l, loss, j in self.edges:
             pairs = [(u, w)] if self.directed else [(u, w), (w, u)]
             for a, b in pairs:
                 if l < lat[a, b]:
                     lat[a, b] = l
                     neglog[a, b] = -np.log(max(1.0 - loss, 1e-30))
-        return lat, neglog
+                    jit[a, b] = j
+        return lat, neglog, jit
 
     def _is_complete(self, lat: np.ndarray) -> bool:
         # every vertex must have an edge to every vertex *including itself*
@@ -218,17 +222,20 @@ class Topology:
         return bool(np.all(np.isfinite(lat)))
 
     def compute_all_pairs(self):
-        """(latency_ms f64[V,V], reliability f32[V,V]) over path semantics."""
+        """(latency_ms f64[V,V], reliability f32[V,V], jitter_ms f64[V,V])
+        over path semantics; jitter accumulates along paths like latency
+        (edge attrs, topology.c:101-105)."""
         if self._lat_ms is not None:
-            return self._lat_ms, self._rel
+            return self._lat_ms, self._rel, self._jit_ms
         v = self.n_vertices
-        w_lat, w_neglog = self._edge_matrices()
+        w_lat, w_neglog, w_jit = self._edge_matrices()
         vloss = np.array([vx.packetloss for vx in self.vertices])
         v_neglog = -np.log(np.maximum(1.0 - vloss, 1e-30))
 
         if self._is_complete(w_lat):
             lat = w_lat.copy()
             neglog = w_neglog.copy()
+            jit = w_jit.copy()
         else:
             if csr_matrix is None:  # pragma: no cover
                 raise RuntimeError("scipy unavailable for Dijkstra")
@@ -238,17 +245,20 @@ class Topology:
                 graph, directed=True, return_predecessors=True
             )
             neglog = self._path_cost_along_tree(pred, w_neglog)
+            jit = self._path_cost_along_tree(pred, w_jit)
             lat = dist
             # diagonal: dijkstra gives 0; apply the self-path rule
             np.fill_diagonal(lat, np.inf)
             np.fill_diagonal(neglog, 0.0)
-            self._fill_self_paths(lat, neglog, w_lat, w_neglog)
+            np.fill_diagonal(jit, 0.0)
+            self._fill_self_paths(lat, neglog, jit, w_lat, w_neglog, w_jit)
             if self.prefer_direct_paths:
                 # adjacent pairs use the direct edge even if a multi-hop
                 # path is shorter (topology.c:1321-1336 shouldStorePath)
                 use = np.isfinite(w_lat)
                 lat[use] = w_lat[use]
                 neglog[use] = w_neglog[use]
+                jit[use] = w_jit[use]
 
         # endpoint vertex loss applies for src != dst paths
         # (topology.c:1441-1463; self paths use edge loss only :1641)
@@ -256,8 +266,8 @@ class Topology:
         neglog = neglog + off * (v_neglog[:, None] + v_neglog[None, :])
         rel = np.exp(-neglog).astype(np.float32)
         rel[~np.isfinite(lat)] = 0.0
-        self._lat_ms, self._rel = lat, rel
-        return lat, rel
+        self._lat_ms, self._rel, self._jit_ms = lat, rel, jit
+        return lat, rel, jit
 
     @staticmethod
     def _path_cost_along_tree(pred: np.ndarray, w: np.ndarray) -> np.ndarray:
@@ -284,7 +294,7 @@ class Topology:
         return cost
 
     @staticmethod
-    def _fill_self_paths(lat, neglog, w_lat, w_neglog):
+    def _fill_self_paths(lat, neglog, jit, w_lat, w_neglog, w_jit):
         """Self paths: min-latency incident edge used twice
         (topology.c:1545-1652). A direct self-loop edge, if present, is its
         own incident edge — giving 2x its latency like the reference."""
@@ -295,25 +305,31 @@ class Topology:
         m = inc[rows, best]
         lat[rows, rows] = 2.0 * m
         neglog[rows, rows] = 2.0 * w_neglog[rows, best]
+        jit[rows, rows] = 2.0 * w_jit[rows, best]
 
     @property
     def min_latency_ms(self) -> float:
         """Graph-wide minimum edge latency — the conservative lookahead
-        (topology.c:1374-1385, master.c:133-159)."""
+        (topology.c:1374-1385, master.c:133-159). Jitter can shrink an
+        edge's effective latency, so it tightens the bound."""
         if not self.edges:
             return 1.0
-        return min(e[2] for e in self.edges)
+        return max(min(e[2] - e[4] for e in self.edges), 0.001)
 
     # -------------------------------------------------------- device side
     def build_network(self, host_vertex: Sequence[int]) -> "GraphNetwork":
-        lat_ms, rel = self.compute_all_pairs()
+        lat_ms, rel, jit_ms = self.compute_all_pairs()
         lat_ns = np.where(
             np.isfinite(lat_ms), lat_ms * MILLISECOND, np.int64(2**62)
+        ).astype(np.int64)
+        jit_ns = np.where(
+            np.isfinite(lat_ms), jit_ms * MILLISECOND, 0
         ).astype(np.int64)
         return GraphNetwork(
             host2v=jnp.asarray(np.asarray(host_vertex, np.int32)),
             lat=jnp.asarray(lat_ns),
             rel=jnp.asarray(rel),
+            jit=jnp.asarray(jit_ns),
         )
 
 
@@ -329,11 +345,16 @@ class GraphNetwork:
     host2v: jax.Array  # i32[H_global] host -> attached vertex
     lat: jax.Array  # i64[V, V] path latency ns
     rel: jax.Array  # f32[V, V] path reliability
+    jit: jax.Array  # i64[V, V] path jitter amplitude ns
 
     def route(self, src_gid, dst_gid):
         sv = self.host2v[src_gid]
         dv = self.host2v[dst_gid]
-        return self.lat[sv, dv], self.rel[sv, dv]
+        return self.lat[sv, dv], self.rel[sv, dv], self.jit[sv, dv]
+
+    @property
+    def has_jitter(self) -> bool:
+        return bool(jnp.any(self.jit > 0))
 
     @property
     def min_latency_ns(self) -> int:

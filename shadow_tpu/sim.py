@@ -40,6 +40,12 @@ from shadow_tpu.transport.tcp import TCP
 
 DEFAULT_BANDWIDTH_KIB = 10240  # when neither host attr nor vertex attr set
 
+# Virtual-CPU model: every executed event costs this many cycles on the
+# host's configured CPU (the reference scales measured wall time by
+# rawFrequency/virtualFrequency, cpu.c:56-107; with jitted handlers there
+# is no wall time to measure, so a fixed per-event cycle budget stands in).
+CPU_CYCLES_PER_EVENT = 10_000
+
 
 @dataclasses.dataclass
 class SimBuild:
@@ -397,12 +403,52 @@ def build_simulation(
     # -- NIC sizing: host attr overrides vertex attr (docs/3.1 host element)
     bw_up = np.zeros((n_hosts,), np.float64)
     bw_down = np.zeros((n_hosts,), np.float64)
+    cpu_cost = np.zeros((n_hosts,), np.int64)
+    rcv_wnd_bytes = np.zeros((n_hosts,), np.int64)
+    proc_stop = np.full((n_hosts,), np.iinfo(np.int64).max, np.int64)
     for h, v in zip(hosts, host_vertex):
         vx = topo.vertices[v]
-        bw_up[h.gid] = h.spec.bandwidthup or vx.bandwidth_up_kib or DEFAULT_BANDWIDTH_KIB
+        s = h.spec
+        bw_up[h.gid] = s.bandwidthup or vx.bandwidth_up_kib or DEFAULT_BANDWIDTH_KIB
         bw_down[h.gid] = (
-            h.spec.bandwidthdown or vx.bandwidth_down_kib or DEFAULT_BANDWIDTH_KIB
+            s.bandwidthdown or vx.bandwidth_down_kib or DEFAULT_BANDWIDTH_KIB
         )
+        # semantics-bearing host attrs must act or fail loudly (round-1
+        # accepted-and-ignored them, silently changing results)
+        if s.cpufrequency:
+            cpu_cost[h.gid] = CPU_CYCLES_PER_EVENT * 1_000_000 // s.cpufrequency
+        if s.socketrecvbuffer:
+            rcv_wnd_bytes[h.gid] = s.socketrecvbuffer
+        if s.socketsendbuffer:
+            raise ValueError(
+                f"host {h.name!r}: socketsendbuffer is not implemented for "
+                "jitted app models (they cannot block on a full send "
+                "buffer); remove the attribute"
+            )
+        if s.interfacebuffer:
+            raise ValueError(
+                f"host {h.name!r}: interfacebuffer is not implemented (the "
+                "NIC model uses a fluid token bucket + CoDel AQM); remove "
+                "the attribute"
+            )
+        if s.logpcap or s.pcapdir:
+            raise ValueError(
+                f"host {h.name!r}: pcap capture is not implemented yet; "
+                "remove logpcap/pcapdir"
+            )
+        stops = {p.stoptime for p in s.processes if p.stoptime}
+        if stops:
+            if len(s.processes) > 1 and (
+                len(stops) > 1 or len(stops) < len(s.processes)
+            ):
+                # app-handler muting is per host; a partial stop would
+                # silently kill the host's other processes too
+                raise ValueError(
+                    f"host {h.name!r}: all processes on a host must share "
+                    "one stoptime (per-process stop is not implemented "
+                    "for multi-process hosts)"
+                )
+            proc_stop[h.gid] = int(stops.pop() * SECOND)
 
     if app_model is not None:
         model = app_model
@@ -412,6 +458,7 @@ def build_simulation(
     net = HostNet.create(
         n_hosts, n_sockets, jnp.asarray(bw_up), jnp.asarray(bw_down),
         with_tcp=model.needs_tcp,
+        rcv_wnd_bytes=rcv_wnd_bytes if rcv_wnd_bytes.any() else None,
     )
 
     b = SimBuild(
@@ -429,6 +476,59 @@ def build_simulation(
         def on_recv(hs, slot, pkt, now, key):  # noqa: F811
             from shadow_tpu.core.engine import Emit
             return hs, Emit.none(1, N_PKT_ARGS)
+
+    # <process stoptime>: a stopped process's callbacks never run again
+    # (the reference kills the plugin; its sockets keep the kernel-side
+    # teardown going — here the stack/TCP handlers likewise continue)
+    if (proc_stop < np.iinfo(np.int64).max).any():
+        stop_arr = jnp.asarray(proc_stop)
+
+        def _dead_select(hs, hs2, em, dead):
+            hs_out = jax.tree.map(lambda a, b: jnp.where(dead, a, b), hs, hs2)
+            return hs_out, dataclasses.replace(em, mask=em.mask & ~dead)
+
+        def _mute_handler(fn):
+            def wrapped(hs, ev, key):
+                hs2, em = fn(hs, ev, key)
+                return _dead_select(hs, hs2, em, ev.time >= stop_arr[ev.dst])
+
+            return wrapped
+
+        # fail at build time, not trace time, when recv-muting can't
+        # recover the lane's host id from the app state
+        def _gid_resolvable(app):
+            return hasattr(app, "gid") or any(
+                hasattr(sub, "gid") for sub in getattr(app, "subs", ())
+            )
+
+        if not _gid_resolvable(app_state):
+            raise ValueError(
+                "process stoptime needs an app state with a gid field "
+                f"(model {model.name!r} has none)"
+            )
+
+        def _lane_gid(app):
+            if hasattr(app, "gid"):
+                return app.gid
+            for sub in app.subs:
+                if hasattr(sub, "gid"):
+                    return sub.gid
+            raise AssertionError  # unreachable: checked at build
+
+        def _mute_recv(fn):
+            def wrapped(hs, slot, pkt, now, key):
+                hs2, em = fn(hs, slot, pkt, now, key)
+                dead = now >= stop_arr[_lane_gid(hs.app)]
+                return _dead_select(hs, hs2, em, dead)
+
+            return wrapped
+
+        make_inner = make_handlers
+
+        def make_handlers(stack_, kind_base_):  # noqa: F811
+            return [_mute_handler(fn) for fn in make_inner(stack_, kind_base_)]
+
+        on_recv = _mute_recv(on_recv) if on_recv is not None else None
 
     base_handlers = stack.make_handlers(on_recv)
     kind_base = len(base_handlers)
@@ -457,7 +557,15 @@ def build_simulation(
         axis_name=axis_name, n_shards=n_shards,
     )
     network = topo.build_network(host_vertex)
-    eng = Engine(ecfg, handlers, network)
+    if mesh is not None and cpu_cost.any():
+        raise NotImplementedError(
+            "cpufrequency with --mesh: per-shard CPU cost slicing is not "
+            "wired yet"
+        )
+    eng = Engine(
+        ecfg, handlers, network,
+        cpu_cost=jnp.asarray(cpu_cost) if cpu_cost.any() else None,
+    )
 
     # -- initial events: process starts (slave.c:296-336 scheduling of
     # process start tasks at starttime)

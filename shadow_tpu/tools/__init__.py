@@ -1,0 +1,3 @@
+"""Post-run analysis tooling (the reference ships parse-shadow.py /
+plot-shadow.py under src/tools; these are their shadow_tpu-native
+counterparts)."""

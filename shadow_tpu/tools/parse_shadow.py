@@ -1,0 +1,95 @@
+"""parse_shadow: heartbeat log -> stats.shadow.json.
+
+The reference's parse-shadow.py greps `[shadow-heartbeat]` lines out of
+(possibly xz-compressed) simulator logs and writes a per-node JSON time
+series consumed by plot-shadow.py (reference: src/tools/parse-shadow.py:
+9-40, stats.shadow.json). This tool does the same for shadow_tpu's
+heartbeat format (utils/tracker.py): per node, per interval, the
+payload/wire/header byte classes, packet counts, retransmissions, events
+and drops — plus a run-level ticks series.
+
+Usage:
+    python -m shadow_tpu.tools.parse_shadow shadow.log [-o DIR]
+    ... | python -m shadow_tpu.tools.parse_shadow -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import lzma
+import os
+import sys
+
+NODE_FIELDS = (
+    "bytes_payload_recv", "bytes_payload_send",
+    "bytes_wire_recv", "bytes_wire_send",
+    "packets_recv", "packets_send",
+    "bytes_header_recv", "bytes_header_send",
+    "retrans_segments", "events_executed", "queue_drops",
+)
+
+
+def parse_lines(lines) -> dict:
+    nodes: dict[str, dict] = {}
+    sockets: dict[str, list] = {}
+    for line in lines:
+        if "[shadow-heartbeat] [node] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [node] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 2 + len(NODE_FIELDS):
+                continue
+            t_s, name = int(parts[0]), parts[1]
+            node = nodes.setdefault(
+                name,
+                {"ticks": [], **{f: [] for f in NODE_FIELDS}},
+            )
+            node["ticks"].append(t_s)
+            for f, v in zip(NODE_FIELDS, parts[2:]):
+                node[f].append(int(v))
+        elif "[shadow-heartbeat] [socket] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [socket] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 10:
+                continue
+            sockets.setdefault(parts[1], []).append(
+                {
+                    "time": int(parts[0]),
+                    "slot": int(parts[2]),
+                    "protocol": parts[3],
+                    "local_port": int(parts[4]),
+                    "peer_host": int(parts[5]),
+                    "peer_port": int(parts[6]),
+                    "recv_bytes": int(parts[7]),
+                    "send_bytes": int(parts[8]),
+                    "retrans_segments": int(parts[9]),
+                }
+            )
+    return {"nodes": nodes, "sockets": sockets}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logpath", help="log file, .xz allowed, or - for stdin")
+    ap.add_argument("-o", "--output-dir", default=".",
+                    help="directory for stats.shadow_tpu.json")
+    args = ap.parse_args(argv)
+
+    if args.logpath == "-":
+        stats = parse_lines(sys.stdin)
+    elif args.logpath.endswith(".xz"):
+        with lzma.open(args.logpath, "rt") as f:
+            stats = parse_lines(f)
+    else:
+        with open(args.logpath) as f:
+            stats = parse_lines(f)
+
+    out = os.path.join(args.output_dir, "stats.shadow_tpu.json")
+    with open(out, "w") as f:
+        json.dump(stats, f)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -123,14 +123,23 @@ class HostNet:
 
     @staticmethod
     def create(n_hosts: int, n_sockets: int, bw_up_kib, bw_down_kib,
-               with_tcp: bool = False) -> "HostNet":
+               with_tcp: bool = False, rcv_wnd_bytes=None) -> "HostNet":
         up = jnp.broadcast_to(jnp.asarray(bw_up_kib), (n_hosts,))
         down = jnp.broadcast_to(jnp.asarray(bw_down_kib), (n_hosts,))
         tcb = None
         if with_tcp:
-            from shadow_tpu.transport.tcp import TCB
+            from shadow_tpu.transport.tcp import MSS, RCV_WND, TCB
 
-            tcb = TCB.create(n_hosts, n_sockets)
+            # socketrecvbuffer sets the advertised window, capped at the
+            # reassembly bitmap width (host.c autotuned buffers -> here a
+            # static per-host window; tcp.c:407-598)
+            rcv_wnd = None
+            if rcv_wnd_bytes is not None:
+                rb = jnp.asarray(rcv_wnd_bytes, jnp.int64)
+                rcv_wnd = jnp.where(
+                    rb > 0, jnp.clip(rb // MSS, 1, RCV_WND), RCV_WND
+                ).astype(jnp.int32)
+            tcb = TCB.create(n_hosts, n_sockets, rcv_wnd=rcv_wnd)
         return HostNet(
             nic_tx=NIC.create(up),
             nic_rx=NIC.create(down),

@@ -143,9 +143,10 @@ class TCB:
     timer_gen: jax.Array  # i32 generation for stale-timer rejection
     peer_wnd: jax.Array  # i32 advertised window (segments)
     n_retx: jax.Array  # i32 retransmitted segments (observability)
+    rwnd: jax.Array  # i32 window we advertise (socketrecvbuffer / MSS)
 
     @staticmethod
-    def create(n_hosts: int, n_sockets: int) -> "TCB":
+    def create(n_hosts: int, n_sockets: int, rcv_wnd=None) -> "TCB":
         s = (n_hosts, n_sockets)
         zi = jnp.zeros(s, _I32)
         zl = jnp.zeros(s, _I64)
@@ -173,6 +174,13 @@ class TCB:
             timer_gen=zi,
             peer_wnd=jnp.full(s, RCV_WND, _I32),
             n_retx=zi,
+            rwnd=(
+                jnp.full(s, RCV_WND, _I32)
+                if rcv_wnd is None
+                else jnp.broadcast_to(
+                    jnp.asarray(rcv_wnd, _I32)[:, None], s
+                )
+            ),
         )
 
     def listen(self, host: int, slot: int) -> "TCB":
@@ -220,6 +228,7 @@ def _fresh_row_like(old: TCB) -> TCB:
         timer_gen=old.timer_gen,
         peer_wnd=jnp.int32(RCV_WND),
         n_retx=old.n_retx,
+        rwnd=old.rwnd,
     )
 
 
@@ -328,7 +337,7 @@ class TCP:
         flags = F_ACK | jnp.where(is_fin, F_FIN, 0)
         args = _pkt_args(
             sport, dport, seq=s, ack=row.rcv_nxt, length=length,
-            aux=_ts_us(now), flags=flags,
+            wnd=row.rwnd, aux=_ts_us(now), flags=flags,
         )
         em = dict(
             dst=dst_host, dt=jnp.where(ok, fin_t - now, 0),
@@ -430,7 +439,7 @@ class TCP:
             kind=KIND_PKT_ARRIVE,
             args=_pkt_args(
                 net.sockets.local_port[c], net.sockets.peer_port[c],
-                aux=_ts_us(now), flags=F_SYN,
+                wnd=row.rwnd, aux=_ts_us(now), flags=F_SYN,
             ),
             mask=mask, local=False,
         )
@@ -776,7 +785,7 @@ class TCP:
             kind=KIND_PKT_ARRIVE,
             args=_pkt_args(
                 pkt.dst_port, pkt.src_port, seq=0, ack=ctl_ack, length=0,
-                aux=ctl_aux, flags=ctl_flags,
+                wnd=row.rwnd, aux=ctl_aux, flags=ctl_flags,
             ),
             mask=need_ctl, local=False,
         )
@@ -934,7 +943,8 @@ class TCP:
         hs_row = dict(
             dst=peer_h, dt=jnp.where(hs_mask, fin_t - now, 0),
             kind=KIND_PKT_ARRIVE,
-            args=_pkt_args(sport, peer_p, aux=_ts_us(now), flags=hs_flags),
+            args=_pkt_args(sport, peer_p, wnd=row.rwnd, aux=_ts_us(now),
+                           flags=hs_flags),
             mask=hs_mask, local=False,
         )
         # re-arm: early -> at deadline (same gen); timeout -> +rto'

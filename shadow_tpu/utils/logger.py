@@ -1,0 +1,79 @@
+"""Simtime-ordered buffered logger with per-host log levels.
+
+The reference's ShadowLogger batches records per worker thread and ships
+them to a helper pthread that sorts by simulated time before writing
+(reference: src/main/core/logger/shadow_logger.c:23-58), with per-host
+level overrides (:102-121). Here record producers are the host-side run
+loop, the tracker, and native-process log calls — device code never
+formats strings — so the logger is a plain buffered sorter: records
+accumulate with a (sim_ns, seq) key and flush in simulated order, which
+keeps interleaved multi-host output deterministic no matter what order
+the host code produced it in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import IO
+
+LEVELS = ("error", "critical", "warning", "message", "info", "debug")
+_RANK = {name: i for i, name in enumerate(LEVELS)}
+
+
+@dataclasses.dataclass(frozen=True)
+class LogRecord:
+    sim_ns: int
+    seq: int
+    host: str
+    level: str
+    message: str
+
+    def format(self) -> str:
+        s, ns = divmod(self.sim_ns, 1_000_000_000)
+        h, rem = divmod(s, 3600)
+        m, sec = divmod(rem, 60)
+        return (
+            f"{h:02d}:{m:02d}:{sec:02d}.{ns // 1000:06d} "
+            f"[{self.level}] [{self.host}] {self.message}"
+        )
+
+
+class ShadowLogger:
+    """Buffered, simtime-sorted log sink."""
+
+    def __init__(self, default_level: str = "message",
+                 stream: IO | None = None):
+        self._default = _RANK[default_level]
+        self._host_levels: dict[str, int] = {}
+        self._buf: list[LogRecord] = []
+        self._seq = 0
+        self._stream = stream if stream is not None else sys.stdout
+
+    def set_default_level(self, level: str) -> None:
+        self._default = _RANK[level]
+
+    def set_host_level(self, host: str, level: str) -> None:
+        """Per-host override (shadow_logger.c:102-121; host loglevel attr)."""
+        if level:
+            self._host_levels[host] = _RANK[level]
+
+    def enabled(self, host: str, level: str) -> bool:
+        return _RANK[level] <= self._host_levels.get(host, self._default)
+
+    def log(self, sim_ns: int, host: str, level: str, message: str) -> None:
+        if not self.enabled(host, level):
+            return
+        self._buf.append(
+            LogRecord(int(sim_ns), self._seq, host, level, message)
+        )
+        self._seq += 1
+
+    def flush(self) -> int:
+        """Write buffered records in (simtime, arrival) order."""
+        self._buf.sort(key=lambda r: (r.sim_ns, r.seq))
+        n = len(self._buf)
+        for r in self._buf:
+            print(r.format(), file=self._stream)
+        self._buf.clear()
+        return n

@@ -58,7 +58,7 @@ GRAPHML_LINE = """<?xml version="1.0" encoding="utf-8"?>
 def test_single_poi_self_loop():
     top = Topology.from_graphml(GRAPHML_1POI)
     assert top.n_vertices == 1
-    lat, rel = top.compute_all_pairs()
+    lat, rel, _jit = top.compute_all_pairs()
     # complete graph (self-loop present): direct edge used
     assert lat[0, 0] == pytest.approx(50.0)
     assert rel[0, 0] == pytest.approx(1 - 0.001, abs=1e-6)
@@ -67,7 +67,7 @@ def test_single_poi_self_loop():
 
 def test_line_graph_paths():
     top = Topology.from_graphml(GRAPHML_LINE)
-    lat, rel = top.compute_all_pairs()
+    lat, rel, _jit = top.compute_all_pairs()
     a, b, c = 0, 1, 2
     # two-hop latency adds; reliability multiplies edge AND endpoint vertex terms
     assert lat[a, c] == pytest.approx(30.0)
@@ -98,7 +98,7 @@ def test_device_network_route():
     top = Topology.from_graphml(GRAPHML_LINE)
     # hosts: h0@a h1@a h2@c
     net = top.build_network([0, 0, 2])
-    lat, rel = net.route(jnp.asarray([0, 0, 1]), jnp.asarray([2, 1, 0]))
+    lat, rel, _jit = net.route(jnp.asarray([0, 0, 1]), jnp.asarray([2, 1, 0]))
     assert int(lat[0]) == 30 * MILLISECOND
     # h0 -> h1 both attach to vertex a: self path = 2 * 10ms
     assert int(lat[1]) == 20 * MILLISECOND
@@ -123,7 +123,7 @@ def test_pointer_jump_matches_bruteforce():
         for i in range(v):
             edges.append((i, (i + 1) % v, 60.0, 0.05, 0.0))
         top = Topology(verts, edges)
-        lat, rel = top.compute_all_pairs()
+        lat, rel, _jit = top.compute_all_pairs()
 
         import networkx as nx
 
@@ -154,7 +154,7 @@ def test_reference_topology_loads():
         pytest.skip("reference topology not present")
     top = Topology.from_graphml(path)
     assert top.n_vertices > 10
-    lat, rel = top.compute_all_pairs()
+    lat, rel, _jit = top.compute_all_pairs()
     assert np.isfinite(lat).all()
     assert (rel > 0).all() and (rel <= 1).all()
     # symmetric undirected measured graph -> symmetric latency
