@@ -49,8 +49,9 @@ def make_parser() -> argparse.ArgumentParser:
                         "(options.c --heartbeat-frequency)")
     p.add_argument("--sockets", type=int, default=8,
                    help="socket slots per host")
-    p.add_argument("--capacity", type=int, default=256,
-                   help="event-queue slots per host")
+    p.add_argument("--capacity", type=int, default=None,
+                   help="event-queue slots per host (default: sized to "
+                        "hold a full TCP receive window in flight)")
     p.add_argument("--allow-queue-overflow", action="store_true",
                    help="count+continue on event-queue overflow instead of "
                         "failing (the reference's queues are unbounded; "
@@ -58,6 +59,22 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-level", "-l", default="message",
                    choices=["error", "critical", "warning", "message",
                             "info", "debug"])
+    p.add_argument("--tcp-congestion-control", default="reno",
+                   choices=["reno", "cubic", "aimd"],
+                   help="congestion-control algorithm for all TCP "
+                        "connections (options.c --tcp-congestion-control)")
+    p.add_argument("--interface-qdisc", default="fifo",
+                   choices=["fifo", "rr"],
+                   help="socket send scheduling: creation-order bursts or "
+                        "per-packet round-robin (options.c interface-qdisc)")
+    p.add_argument("--interface-buffer", type=int, default=1_024_000,
+                   help="NIC receive buffer bytes, drop-tail "
+                        "(options.c:132; interfacebuffer host attr "
+                        "overrides per host)")
+    p.add_argument("--router-queue", default="codel",
+                   choices=["codel", "static", "single"],
+                   help="upstream router queue manager "
+                        "(router.c:50-55 QUEUE_MANAGER_*)")
     p.add_argument("--mesh", type=int, default=0,
                    help="shard hosts over N devices (0 = single device; "
                         "the TPU-era --workers)")
@@ -166,6 +183,9 @@ def main(argv=None) -> int:
             cfg, seed=args.seed, n_sockets=args.sockets,
             capacity=args.capacity,
             strict_overflow=not args.allow_queue_overflow,
+            tcp_cc=args.tcp_congestion_control,
+            rx_queue=args.router_queue, qdisc=args.interface_qdisc,
+            interface_buffer=args.interface_buffer,
         )
         st = tier.run()
         wall = time.perf_counter() - t0
@@ -194,7 +214,9 @@ def main(argv=None) -> int:
         mesh = make_mesh(args.mesh)
     sim = build_simulation(
         cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity,
-        mesh=mesh,
+        mesh=mesh, tcp_cc=args.tcp_congestion_control,
+        rx_queue=args.router_queue, qdisc=args.interface_qdisc,
+        interface_buffer=args.interface_buffer,
     )
     if args.allow_queue_overflow:
         sim.strict_overflow = False
@@ -223,6 +245,10 @@ def main(argv=None) -> int:
                 args.seed,
                 args.sockets,
                 args.capacity,
+                args.tcp_congestion_control,
+                args.interface_qdisc,
+                args.interface_buffer,
+                args.router_queue,
             )
         ).encode()
     ).hexdigest()[:16]
@@ -258,6 +284,16 @@ def main(argv=None) -> int:
     next_hb = (math.floor(sim_s / hb) + 1) * hb if hb > 0 else float("inf")
     next_ckpt = (math.floor(sim_s / ck) + 1) * ck if ck > 0 else float("inf")
     logger, tracker = _make_observability(cfg, sim, args)
+    drain = None
+    if sim.pcap_gids:
+        from shadow_tpu.utils.pcap import CaptureDrain
+
+        drain = CaptureDrain(
+            [sim.names[g] for g in sim.pcap_gids], sim.pcap_gids,
+            sim.pcap_dir, dns=sim.dns,
+        )
+        print(f"pcap capture: {len(sim.pcap_gids)} hosts -> {sim.pcap_dir}/",
+              file=sys.stderr)
     t1 = time.perf_counter()
     while sim_s < stop_s:
         nxt = min(next_hb, next_ckpt, stop_s)
@@ -267,6 +303,8 @@ def main(argv=None) -> int:
         if sim_s >= next_hb:
             tracker.heartbeat(st, int(sim_s * SECOND))
             logger.flush()
+            if drain is not None:
+                drain.drain(st.hosts.net.cap)
             next_hb += hb
         if sim_s >= next_ckpt:
             from shadow_tpu.utils import save_checkpoint
@@ -278,6 +316,12 @@ def main(argv=None) -> int:
             )
             next_ckpt += ck
     wall = time.perf_counter() - t1
+    if drain is not None:
+        drain.drain(st.hosts.net.cap)
+        drain.close()
+        if drain.lost:
+            print(f"pcap: {drain.lost} records lost to ring overrun "
+                  "(raise --heartbeat-frequency cadence)", file=sys.stderr)
 
     stats = st.stats
     executed = int(jax.device_get(stats.n_executed.sum()))

@@ -64,14 +64,30 @@ class NIC:
     burst_ns: jax.Array  # i64 max idle credit (bucket depth in time)
     pkts: jax.Array  # i64 packets admitted (tracker wire accounting)
     wire: jax.Array  # i64 wire bytes admitted (payload + headers)
+    buf_bytes: jax.Array  # i64 drop-tail buffer bound (0 = unlimited)
+    drops: jax.Array  # i64 packets tail-dropped at this NIC
 
     @staticmethod
-    def create(bandwidth_kib, burst_bytes: int = 16 * 1024) -> "NIC":
+    def create(bandwidth_kib, burst_bytes: int = 16 * 1024,
+               buf_bytes=0) -> "NIC":
         rate = kib_per_sec_to_bytes_per_ns(jnp.asarray(bandwidth_kib))
         rate = jnp.maximum(rate, 1e-12).astype(jnp.float32)
         burst = (burst_bytes / rate.astype(jnp.float64)).astype(jnp.int64)
         z = jnp.zeros_like(burst)
-        return NIC(free_at=z, rate=rate, burst_ns=burst, pkts=z, wire=z)
+        return NIC(
+            free_at=z, rate=rate, burst_ns=burst, pkts=z, wire=z,
+            buf_bytes=jnp.broadcast_to(
+                jnp.asarray(buf_bytes, jnp.int64), burst.shape
+            ),
+            drops=z,
+        )
+
+    def backlog_bytes(self, t):
+        """Bytes currently queued behind the virtual clock at time t (the
+        implicit receive queue the reference bounds with interfacebuffer,
+        options.c:132 'interface receive buffer')."""
+        lag = jnp.maximum(self.free_at - jnp.asarray(t, jnp.int64), 0)
+        return (lag.astype(jnp.float32) * self.rate).astype(jnp.int64)
 
     def admit(self, t, nbytes, unlimited=False):
         """Serialize `nbytes` starting no earlier than t.

@@ -83,6 +83,7 @@ def build(
     seed: int = 0,
     axis_name: str | None = None,
     n_shards: int = 1,
+    drain_batch: int = 32,
 ):
     """Build (engine, initial_state) for an n_hosts PHOLD network.
 
@@ -98,6 +99,7 @@ def build(
         seed=seed,
         axis_name=axis_name,
         n_shards=n_shards,
+        drain_batch=drain_batch,
     )
     net = ConstantNetwork(latency_ns)
     eng = Engine(

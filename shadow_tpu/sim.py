@@ -134,6 +134,8 @@ class Simulation:
     app: Any  # the AppModel instance
     stack: Stack
     mesh: Any = None  # jax.sharding.Mesh when sharded
+    pcap_gids: tuple = ()  # hosts with logpcap set
+    pcap_dir: str = "shadow.pcap.d"  # from the pcapdir host attr
 
     _jit_run: Any = None
     _jit_step: Any = None
@@ -375,9 +377,14 @@ def build_simulation(
     *,
     seed: int = 0,
     n_sockets: int = 8,
-    capacity: int = 256,
+    capacity: int | None = None,
     app_model: Any = None,
     mesh: Any = None,
+    tcp_cc: str = "reno",
+    tcp_in_order: bool = True,
+    rx_queue: str = "codel",
+    qdisc: str = "fifo",
+    interface_buffer: int = 1_024_000,
 ) -> Simulation:
     """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts."""
     if registry is None:
@@ -405,6 +412,12 @@ def build_simulation(
     bw_down = np.zeros((n_hosts,), np.float64)
     cpu_cost = np.zeros((n_hosts,), np.int64)
     rcv_wnd_bytes = np.zeros((n_hosts,), np.int64)
+    # NIC receive buffer bound (interfacebuffer host attr; reference
+    # default 1024000 bytes, options.c:78 — CoDel acts long before a
+    # megabyte of standing queue, so the default only bounds pathology)
+    rx_buf = np.full((n_hosts,), interface_buffer, np.int64)
+    pcap_mask = np.zeros((n_hosts,), bool)
+    pcap_dirs: set[str] = set()
     proc_stop = np.full((n_hosts,), np.iinfo(np.int64).max, np.int64)
     for h, v in zip(hosts, host_vertex):
         vx = topo.vertices[v]
@@ -426,16 +439,11 @@ def build_simulation(
                 "buffer); remove the attribute"
             )
         if s.interfacebuffer:
-            raise ValueError(
-                f"host {h.name!r}: interfacebuffer is not implemented (the "
-                "NIC model uses a fluid token bucket + CoDel AQM); remove "
-                "the attribute"
-            )
+            rx_buf[h.gid] = s.interfacebuffer
         if s.logpcap or s.pcapdir:
-            raise ValueError(
-                f"host {h.name!r}: pcap capture is not implemented yet; "
-                "remove logpcap/pcapdir"
-            )
+            pcap_mask[h.gid] = True
+            if s.pcapdir:
+                pcap_dirs.add(s.pcapdir)
         stops = {p.stoptime for p in s.processes if p.stoptime}
         if stops:
             if len(s.processes) > 1 and (
@@ -455,11 +463,25 @@ def build_simulation(
     else:
         parts = resolve_app_models(cfg, registry, hosts)
         model = parts[0][1] if len(parts) == 1 else FusedModel(parts)
+    if capacity is None:
+        # every in-flight packet occupies a destination queue slot, so a
+        # TCP host must hold a full receive window (64*WND_WORDS segs)
+        # plus timers/app events; non-TCP models need far less
+        from shadow_tpu.transport.tcp import WND_WORDS
+
+        capacity = 64 * WND_WORDS * 2 if model.needs_tcp else 256
     net = HostNet.create(
         n_hosts, n_sockets, jnp.asarray(bw_up), jnp.asarray(bw_down),
         with_tcp=model.needs_tcp,
         rcv_wnd_bytes=rcv_wnd_bytes if rcv_wnd_bytes.any() else None,
+        rx_buf_bytes=jnp.asarray(rx_buf),
     )
+    if pcap_mask.any():
+        from shadow_tpu.utils.pcap import CaptureRing
+
+        net = dataclasses.replace(
+            net, cap=CaptureRing.create(jnp.asarray(pcap_mask))
+        )
 
     b = SimBuild(
         cfg=cfg, hosts=hosts, dns=dns, topo=topo, n_sockets=n_sockets,
@@ -469,8 +491,23 @@ def build_simulation(
     net = dataclasses.replace(net, sockets=b.sockets, tcb=b.tcb)
 
     bootstrap_end = int(cfg.bootstraptime * SECOND)
-    tcp = TCP(auto_close=False) if model.needs_tcp else None
-    stack = Stack(bootstrap_end=bootstrap_end, tcp=tcp)
+    # config-driven sims get strict byte-stream delivery order (the
+    # reference's apps read in-order streams); raw-engine users can still
+    # build TCP(in_order=False) for on-arrival accounting.
+    # qdisc 'rr' (options.c interface-qdisc): one segment per tx kick, so
+    # contending connections strictly alternate through the shared NIC
+    # virtual clock — round-robin at packet granularity. 'fifo' (default)
+    # keeps burst transmission; admission follows the event total order,
+    # which *is* packet-creation order (the reference's FIFO qdisc sorts
+    # on a host-monotonic creation counter, packet.c:87-88).
+    if qdisc not in ("fifo", "rr"):
+        raise ValueError(f"unknown qdisc {qdisc!r}")
+    tcp_kw = dict(tx_burst=1, inline_budget=1) if qdisc == "rr" else {}
+    tcp = (
+        TCP(auto_close=False, cc=tcp_cc, in_order=tcp_in_order, **tcp_kw)
+        if model.needs_tcp else None
+    )
+    stack = Stack(bootstrap_end=bootstrap_end, tcp=tcp, rx_queue=rx_queue)
 
     if on_recv is None:
         def on_recv(hs, slot, pkt, now, key):  # noqa: F811
@@ -494,26 +531,33 @@ def build_simulation(
 
             return wrapped
 
-        # fail at build time, not trace time, when recv-muting can't
-        # recover the lane's host id from the app state
-        def _gid_resolvable(app):
-            return hasattr(app, "gid") or any(
-                hasattr(sub, "gid") for sub in getattr(app, "subs", ())
-            )
+        # recv-muting needs the lane's host id from the app state. A model
+        # may declare it via a `lane_gid(app_state_slice)` method (the
+        # AppModel-level contract); the fallback sniffs the conventional
+        # `gid` field every bundled model carries. Fail at build time, not
+        # trace time, when neither resolves.
+        if hasattr(model, "lane_gid"):
+            _lane_gid = model.lane_gid
+        else:
+            def _gid_resolvable(app):
+                return hasattr(app, "gid") or any(
+                    hasattr(sub, "gid") for sub in getattr(app, "subs", ())
+                )
 
-        if not _gid_resolvable(app_state):
-            raise ValueError(
-                "process stoptime needs an app state with a gid field "
-                f"(model {model.name!r} has none)"
-            )
+            if not _gid_resolvable(app_state):
+                raise ValueError(
+                    "process stoptime needs the app model to define "
+                    "lane_gid(app_state) or carry a gid field "
+                    f"(model {model.name!r} has neither)"
+                )
 
-        def _lane_gid(app):
-            if hasattr(app, "gid"):
-                return app.gid
-            for sub in app.subs:
-                if hasattr(sub, "gid"):
-                    return sub.gid
-            raise AssertionError  # unreachable: checked at build
+            def _lane_gid(app):
+                if hasattr(app, "gid"):
+                    return app.gid
+                for sub in app.subs:
+                    if hasattr(sub, "gid"):
+                        return sub.gid
+                raise AssertionError  # unreachable: checked at build
 
         def _mute_recv(fn):
             def wrapped(hs, slot, pkt, now, key):
@@ -629,10 +673,17 @@ def build_simulation(
                 check_vma=False,
             )
         )(hosts_state)
+    if len(pcap_dirs) > 1:
+        raise ValueError(
+            f"hosts disagree on pcapdir ({sorted(pcap_dirs)}); captures "
+            "share one directory per run"
+        )
     return Simulation(
         engine=eng, state0=st0, stop_ns=int(cfg.stoptime * SECOND),
         dns=dns, topo=topo, names=[h.name for h in hosts], app=model,
         stack=stack, mesh=mesh,
+        pcap_gids=tuple(int(g) for g in np.nonzero(pcap_mask)[0]),
+        pcap_dir=(pcap_dirs.pop() if pcap_dirs else "shadow.pcap.d"),
     )
 
 

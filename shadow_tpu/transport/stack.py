@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from shadow_tpu.core.engine import Emit
 from shadow_tpu.core.events import Events
-from shadow_tpu.host.nic import HEADER_TCP, HEADER_UDP, NIC, CoDel
+from shadow_tpu.host.nic import HEADER_TCP, HEADER_UDP, MTU, NIC, CoDel
 from shadow_tpu.host.sockets import PROTO_TCP, PROTO_UDP, SocketTable
 
 # ---------------------------------------------------------------------------
@@ -120,29 +120,35 @@ class HostNet:
     codel: CoDel
     sockets: SocketTable
     tcb: Any = None  # transport.tcp.TCB [H, S] when TCP is installed
+    cap: Any = None  # utils.pcap.CaptureRing when logpcap is set
 
     @staticmethod
     def create(n_hosts: int, n_sockets: int, bw_up_kib, bw_down_kib,
-               with_tcp: bool = False, rcv_wnd_bytes=None) -> "HostNet":
+               with_tcp: bool = False, rcv_wnd_bytes=None,
+               wnd_words: int | None = None, rx_buf_bytes=0) -> "HostNet":
         up = jnp.broadcast_to(jnp.asarray(bw_up_kib), (n_hosts,))
         down = jnp.broadcast_to(jnp.asarray(bw_down_kib), (n_hosts,))
         tcb = None
         if with_tcp:
-            from shadow_tpu.transport.tcp import MSS, RCV_WND, TCB
+            from shadow_tpu.transport.tcp import MSS, TCB, WND_WORDS
 
-            # socketrecvbuffer sets the advertised window, capped at the
-            # reassembly bitmap width (host.c autotuned buffers -> here a
-            # static per-host window; tcp.c:407-598)
+            ww = wnd_words or WND_WORDS
+            cap_max = 64 * ww
+            # socketrecvbuffer caps the autotuned advertised window at the
+            # buffer's segment count (host.c autotuned buffers,
+            # tcp.c:407-598); the hard ceiling is the reassembly bitmap
             rcv_wnd = None
             if rcv_wnd_bytes is not None:
                 rb = jnp.asarray(rcv_wnd_bytes, jnp.int64)
                 rcv_wnd = jnp.where(
-                    rb > 0, jnp.clip(rb // MSS, 1, RCV_WND), RCV_WND
+                    rb > 0, jnp.clip(rb // MSS, 1, cap_max), cap_max
                 ).astype(jnp.int32)
-            tcb = TCB.create(n_hosts, n_sockets, rcv_wnd=rcv_wnd)
+            tcb = TCB.create(
+                n_hosts, n_sockets, rcv_wnd=rcv_wnd, wnd_words=ww
+            )
         return HostNet(
             nic_tx=NIC.create(up),
-            nic_rx=NIC.create(down),
+            nic_rx=NIC.create(down, buf_bytes=rx_buf_bytes),
             codel=CoDel.create(n_hosts),
             sockets=SocketTable.create(n_hosts, n_sockets),
             tcb=tcb,
@@ -171,9 +177,18 @@ class Stack:
     `.app` attributes (use `SimHost` or any compatible dataclass).
     """
 
-    def __init__(self, *, bootstrap_end: int = 0, tcp=None):
+    def __init__(self, *, bootstrap_end: int = 0, tcp=None,
+                 rx_queue: str = "codel"):
+        """rx_queue selects the upstream router's queue manager
+        (router.c:50-55 QUEUE_MANAGER_{CODEL,STATIC,SINGLE}): 'codel'
+        (AQM, the reference host default, host.c:205), 'static' (pure
+        drop-tail against the NIC buffer bound), or 'single' (one packet
+        queued at a time, router_queue_single.c)."""
+        if rx_queue not in ("codel", "static", "single"):
+            raise ValueError(f"unknown rx_queue {rx_queue!r}")
         self.bootstrap_end = bootstrap_end  # unlimited-bandwidth phase end
         self.tcp = tcp  # TCP protocol hook (transport.tcp.TCP instance)
+        self.rx_queue = rx_queue
 
     # ---------------------------------------------------------------- send
     def send_udp(self, hs, now, slot, dst_host, dst_port, nbytes,
@@ -235,19 +250,58 @@ class Stack:
             header = jnp.where(proto == PROTO_TCP, HEADER_TCP, HEADER_UDP)
             wire = ev.args[A_LEN] + header
             unlimited = now < self.bootstrap_end
+            # drop-tail against the NIC receive buffer (interfacebuffer,
+            # options.c:132; 0 = unbounded). 'single' bounds the implicit
+            # queue at one in-service packet (router_queue_single.c)
+            backlog = net.nic_rx.backlog_bytes(now)
+            if self.rx_queue == "single":
+                tail_drop = backlog > MTU
+            else:
+                tail_drop = (net.nic_rx.buf_bytes > 0) & (
+                    backlog + wire > net.nic_rx.buf_bytes
+                )
+            tail_drop = tail_drop & ~unlimited
             nic_rx, start, finish = net.nic_rx.admit(now, wire, unlimited)
             sojourn = start - now
-            codel, drop = net.codel.on_dequeue(start, sojourn)
-            drop = drop & ~unlimited
+            if self.rx_queue == "codel":
+                codel, aqm_drop = net.codel.on_dequeue(start, sojourn)
+                codel = jax.tree.map(
+                    lambda n, o: jnp.where(unlimited | tail_drop, o, n),
+                    codel, net.codel,
+                )
+            else:
+                codel, aqm_drop = net.codel, jnp.asarray(False)
+            drop = (aqm_drop & ~unlimited) | tail_drop
             # a dropped packet never occupies the link
             nic_rx = jax.tree.map(
                 lambda n, o: jnp.where(drop, o, n), nic_rx, net.nic_rx
             )
-            codel = jax.tree.map(
-                lambda n, o: jnp.where(unlimited, o, n), codel, net.codel
+            nic_rx = dataclasses.replace(
+                nic_rx, drops=nic_rx.drops + tail_drop.astype(jnp.int64)
             )
+            cap = net.cap
+            if cap is not None:
+                # packet-lifecycle capture incl. the queue verdict (richer
+                # than the reference's capture, which runs before the
+                # receive queue: network_interface.c:337-373)
+                from shadow_tpu.utils.pcap import (
+                    V_AQM_DROP, V_DELIVERED, V_TAIL_DROP,
+                )
+
+                verdict = jnp.where(
+                    tail_drop, V_TAIL_DROP,
+                    jnp.where(drop, V_AQM_DROP, V_DELIVERED),
+                )
+                cap = cap.append(
+                    now, ev.src, ev.dst, ev.args[A_SPORT], ev.args[A_DPORT],
+                    ev.args[A_META], ev.args[A_LEN], ev.args[A_SEQ],
+                    ev.args[A_ACK], verdict,
+                )
             hs = dataclasses.replace(
-                hs, net=dataclasses.replace(net, nic_rx=nic_rx, codel=codel)
+                hs,
+                net=dataclasses.replace(
+                    net, nic_rx=nic_rx, codel=codel, cap=cap
+                ),
             )
             args = ev.args.at[A_SRC].set(ev.src)  # stash true source
             em = Emit.single(

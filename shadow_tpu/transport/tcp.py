@@ -32,14 +32,25 @@ TPU-native redesign:
   free time (the reference's _tcp_flush + wantsSend loop, tcp.c:1121,
   network_interface.c:519-579).
 
-Fidelity notes (deliberate v1 deviations from the reference):
-- Immediate ACKs (no delayed-ACK timer yet; reference tcp.c delack).
-- Fixed advertised window = RCV_WND segments (no buffer autotuning,
-  reference tcp.c:407-598) — sim apps consume on arrival, so the receive
-  buffer never fills.
-- Application delivery is on-arrival (deduplicated by the seq bitmap)
-  rather than strictly in-order; rcv_nxt still governs ACK generation, so
-  sender dynamics (goodput, retransmits, congestion) are unaffected.
+Fidelity features (round 2):
+- **Delayed ACK** (reference tcp.c delack; definitions.h:130-131
+  CONFIG_TCP_DELACK_MIN = 40ms): an in-order data segment with no ACK
+  already owed delays its ACK up to DELACK_DELAY or until a second
+  segment / out-of-order arrival / FIN forces one; outbound data
+  piggybacks the cumulative ack and clears the debt.
+- **Receive-window autotuning** (tcp.c:407-598 buffer autotuning): the
+  advertised window starts at RCV_WND segments and doubles toward the
+  reassembly capacity whenever a round-trip's delivered segments fill
+  half of it (dynamic right-sizing; RTT estimated from the packet
+  timestamp's one-way delay). socketrecvbuffer caps it per host.
+- **Pluggable congestion control** (tcp_cong.h:17-30 hook vtable): reno
+  (tcp_cong_reno.c), cubic (RFC 8312; the reference CLI advertises it,
+  options.c), and aimd, selected per run.
+- **In-order app delivery** (optional): bytes surface to the app only as
+  rcv_nxt advances — the byte-stream contract the real-binary tier needs;
+  on-arrival counting remains the default for raw-engine users.
+
+Remaining deliberate deviations:
 - NewReno without SACK scoreboard: partial ACKs retransmit snd_una.
 - A refilled partial segment is tracked for exactly one outstanding
   partial (the common request/response case); overlapping multiple
@@ -91,10 +102,14 @@ RTO_INIT = SECOND
 RTO_MIN = SECOND // 5
 RTO_MAX = 120 * SECOND
 TIME_WAIT_DELAY = 60 * SECOND
+DELACK_DELAY = 40 * MILLISECOND  # definitions.h:130 CONFIG_TCP_DELACK_MIN
 INIT_CWND = 10.0
-INIT_SSTHRESH = 64.0
+# slow start runs until the first loss (tcp_cong_reno.c:124
+# ssthresh = INT32_MAX); the f32 value just has to dwarf CWND_MAX
+INIT_SSTHRESH = float(1 << 30)
 CWND_MAX = 1024.0
-RCV_WND = 64  # segments: the reassembly bitmap width & advertised window
+RCV_WND = 64  # segments: the initial advertised window
+WND_WORDS = 4  # u64 words in the reassembly bitmap (64 segs each)
 
 # Event kinds provided by this module (appended after the stack's).
 KIND_TCP_TIMER = N_STACK_KINDS  # 2
@@ -107,6 +122,7 @@ T_GEN = 1
 T_KIND = 2
 TK_RTO = 0
 TK_TIMEWAIT = 1
+TK_DELACK = 2
 
 _I32 = jnp.int32
 _I64 = jnp.int64
@@ -127,7 +143,7 @@ class TCB:
     snd_buf: jax.Array  # i64 total bytes written by the app
     fin_pending: jax.Array  # bool app closed; FIN occupies seq n_segs
     rcv_nxt: jax.Array  # i32 next expected segment
-    ooo: jax.Array  # u64 bitmap: bit i = segment rcv_nxt+i received
+    ooo: jax.Array  # u64[W] bitmap: bit i = segment rcv_nxt+i received
     rfin_seq: jax.Array  # i32 peer FIN's seq (-1 none)
     partial_seq: jax.Array  # i32 last partial segment delivered (-1 none)
     partial_len: jax.Array  # i32 bytes delivered for it
@@ -143,14 +159,31 @@ class TCB:
     timer_gen: jax.Array  # i32 generation for stale-timer rejection
     peer_wnd: jax.Array  # i32 advertised window (segments)
     n_retx: jax.Array  # i32 retransmitted segments (observability)
-    rwnd: jax.Array  # i32 window we advertise (socketrecvbuffer / MSS)
+    rwnd: jax.Array  # i32 window we advertise (autotuned upward)
+    rwnd_cap: jax.Array  # i32 autotune ceiling (socketrecvbuffer / MSS)
+    delack_segs: jax.Array  # i32 in-order segments with a delayed ACK owed
+    delack_live: jax.Array  # bool a delack timer event is in flight
+    pend_echo: jax.Array  # i32 ts to echo in the next (possibly delayed) ACK
+    rcv_ep_start: jax.Array  # i64 autotune epoch start (0 = unset)
+    rcv_ep_segs: jax.Array  # i32 segments delivered this epoch
+    cc_wmax: jax.Array  # f32 cubic W_max (cwnd at last loss)
+    cc_epoch: jax.Array  # i64 cubic epoch start (0 = unset)
+    conn_gen: jax.Array  # i32 slot incarnation (stale-delack rejection)
 
     @staticmethod
-    def create(n_hosts: int, n_sockets: int, rcv_wnd=None) -> "TCB":
+    def create(n_hosts: int, n_sockets: int, rcv_wnd=None,
+               wnd_words: int = WND_WORDS) -> "TCB":
         s = (n_hosts, n_sockets)
         zi = jnp.zeros(s, _I32)
         zl = jnp.zeros(s, _I64)
         zb = jnp.zeros(s, bool)
+        cap_max = 64 * wnd_words
+        if rcv_wnd is None:
+            cap = jnp.full(s, cap_max, _I32)
+        else:
+            cap = jnp.broadcast_to(
+                jnp.clip(jnp.asarray(rcv_wnd, _I32), 1, cap_max)[:, None], s
+            )
         return TCB(
             state=zi,
             snd_una=zi,
@@ -158,7 +191,7 @@ class TCB:
             snd_buf=zl,
             fin_pending=zb,
             rcv_nxt=zi,
-            ooo=jnp.zeros(s, jnp.uint64),
+            ooo=jnp.zeros(s + (wnd_words,), jnp.uint64),
             rfin_seq=jnp.full(s, -1, _I32),
             partial_seq=jnp.full(s, -1, _I32),
             partial_len=zi,
@@ -174,13 +207,16 @@ class TCB:
             timer_gen=zi,
             peer_wnd=jnp.full(s, RCV_WND, _I32),
             n_retx=zi,
-            rwnd=(
-                jnp.full(s, RCV_WND, _I32)
-                if rcv_wnd is None
-                else jnp.broadcast_to(
-                    jnp.asarray(rcv_wnd, _I32)[:, None], s
-                )
-            ),
+            rwnd=jnp.minimum(jnp.int32(RCV_WND), cap),
+            rwnd_cap=cap,
+            delack_segs=zi,
+            delack_live=zb,
+            pend_echo=zi,
+            rcv_ep_start=zl,
+            rcv_ep_segs=zi,
+            cc_wmax=jnp.zeros(s, jnp.float32),
+            cc_epoch=zl,
+            conn_gen=zi,
         )
 
     def listen(self, host: int, slot: int) -> "TCB":
@@ -203,7 +239,8 @@ def _write_row(tcb, c, new, mask):
 
 def _fresh_row_like(old: TCB) -> TCB:
     """Default-valued scalar row preserving timer_gen (so stale timer
-    events from a previous connection on this slot never match)."""
+    events from a previous connection on this slot never match) and the
+    per-host receive-buffer cap."""
     z32 = jnp.int32(0)
     return TCB(
         state=z32,
@@ -212,7 +249,7 @@ def _fresh_row_like(old: TCB) -> TCB:
         snd_buf=jnp.int64(0),
         fin_pending=jnp.asarray(False),
         rcv_nxt=z32,
-        ooo=jnp.uint64(0),
+        ooo=jnp.zeros_like(old.ooo),
         rfin_seq=jnp.int32(-1),
         partial_seq=jnp.int32(-1),
         partial_len=z32,
@@ -228,7 +265,16 @@ def _fresh_row_like(old: TCB) -> TCB:
         timer_gen=old.timer_gen,
         peer_wnd=jnp.int32(RCV_WND),
         n_retx=old.n_retx,
-        rwnd=old.rwnd,
+        rwnd=jnp.minimum(jnp.int32(RCV_WND), old.rwnd_cap),
+        rwnd_cap=old.rwnd_cap,
+        delack_segs=z32,
+        delack_live=jnp.asarray(False),
+        pend_echo=z32,
+        rcv_ep_start=jnp.int64(0),
+        rcv_ep_segs=z32,
+        cc_wmax=jnp.float32(0.0),
+        cc_epoch=jnp.int64(0),
+        conn_gen=old.conn_gen + 1,
     )
 
 
@@ -262,6 +308,174 @@ def _trailing_ones(x):
     (tcp_retransmit_tally.cc)."""
     y = (x + jnp.uint64(1)).astype(jnp.uint64)
     return jax.lax.population_count((y & (~y + jnp.uint64(1))) - jnp.uint64(1)).astype(_I32)
+
+
+def _trailing_ones_vec(ooo):
+    """Trailing ones across a [W]-word bitmap (word 0 = lowest bits)."""
+    t = jax.vmap(_trailing_ones)(ooo)  # i32[W]
+    full = (t == 64).astype(_I32)
+    # word i contributes only if all lower words are saturated
+    pre = jnp.concatenate([jnp.ones((1,), _I32), jnp.cumprod(full[:-1])])
+    return jnp.sum(t * pre).astype(_I32)
+
+
+def _bit_vec(off, w: int):
+    """One-hot [W]-word u64 vector for bit `off` (off in [0, 64*w))."""
+    wi = off // 64
+    bi = jnp.clip(off - wi * 64, 0, 63).astype(jnp.uint64)
+    sel = jnp.arange(w, dtype=_I32) == wi
+    return jnp.where(sel, jnp.uint64(1) << bi, jnp.uint64(0))
+
+
+def _bit_test(ooo, off):
+    """Is bit `off` set in the [W]-word bitmap? (off must be >= 0)."""
+    w = ooo.shape[0]
+    wi = jnp.clip(off // 64, 0, w - 1)
+    bi = jnp.clip(off - (off // 64) * 64, 0, 63).astype(jnp.uint64)
+    return ((ooo[wi] >> bi) & jnp.uint64(1)) != 0
+
+
+def _shift_right_vec(ooo, adv):
+    """Shift a [W]-word bitmap right by `adv` bits (adv in [0, 64*W])."""
+    w = ooo.shape[0]
+    ws = adv // 64
+    bs = jnp.clip(adv - ws * 64, 0, 63).astype(jnp.uint64)
+    padded = jnp.concatenate([ooo, jnp.zeros((w + 1,), jnp.uint64)])
+    idx = jnp.arange(w, dtype=_I32) + ws
+    lo = jnp.take(padded, idx, mode="clip")
+    hi = jnp.take(padded, idx + 1, mode="clip")
+    return (lo >> bs) | jnp.where(
+        bs > 0, hi << (jnp.uint64(64) - bs), jnp.uint64(0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Congestion-control hook tables (the reference's TCPCongHooks vtable,
+# tcp_cong.h:17-30: {duplicate_ack, fast_recovery, new_ack, timeout,
+# ssthresh} + cwnd). Each hook is elementwise over scalar TCB rows; the
+# algorithm is chosen per run (options.c --tcp-congestion-control), so
+# dispatch is plain Python — zero device cost.
+#
+# Hook contract:
+#   on_ack(row, n_acked, now) -> (cwnd', cc_wmax', cc_epoch')
+#       congestion-avoidance/slow-start growth on an advancing ACK
+#       outside recovery.
+#   on_loss(row, flight, now) -> (cwnd', ssthresh', cc_wmax', cc_epoch')
+#       fast-retransmit entry (3 dup acks).
+#   on_timeout(row, flight, now) -> (ssthresh', cc_wmax', cc_epoch')
+#       RTO collapse (cwnd is always forced to 1 by the caller).
+
+
+class RenoCC:
+    """NewReno (tcp_cong_reno.c:13-60 slow-start/CA/fast-recovery)."""
+
+    name = "reno"
+
+    @staticmethod
+    def on_ack(row, n_acked, now):
+        n = n_acked.astype(jnp.float32)
+        cwnd = jnp.where(
+            row.cwnd < row.ssthresh,
+            row.cwnd + n,
+            row.cwnd + n / jnp.maximum(row.cwnd, 1.0),
+        )
+        return cwnd, row.cc_wmax, row.cc_epoch
+
+    @staticmethod
+    def on_loss(row, flight, now):
+        ss = jnp.maximum(flight / 2, 2.0)
+        return ss + 3, ss, row.cc_wmax, row.cc_epoch
+
+    @staticmethod
+    def on_timeout(row, flight, now):
+        return jnp.maximum(flight / 2, 2.0), row.cc_wmax, row.cc_epoch
+
+
+class AimdCC:
+    """Classic AIMD: reno growth, multiplicative halving on loss with no
+    fast-recovery inflation (the reference CLI's 'aimd', options.c)."""
+
+    name = "aimd"
+
+    on_ack = RenoCC.on_ack
+
+    @staticmethod
+    def on_loss(row, flight, now):
+        ss = jnp.maximum(flight / 2, 2.0)
+        return ss, ss, row.cc_wmax, row.cc_epoch
+
+    on_timeout = RenoCC.on_timeout
+
+
+class CubicCC:
+    """CUBIC (RFC 8312, the Linux bictcp shape): concave/convex window
+    growth W(t) = C*(t-K)^3 + origin with K = cbrt((origin - cwnd0)/C),
+    where cwnd0 is the cwnd when the epoch starts — if cwnd0 >= W_max
+    (no-loss epoch), K = 0 and origin = cwnd0, i.e. immediate convex
+    growth (Linux bictcp_update's last_max <= cwnd case). A TCP-friendly
+    floor tracks what reno would have reached since the epoch.
+
+    cc_wmax doubles as the epoch origin; cc_epoch == 0 means "epoch not
+    started" and the next CA ack starts it (storing K via the origin)."""
+
+    name = "cubic"
+    C = 0.4
+    BETA = 0.7
+
+    @classmethod
+    def on_ack(cls, row, n_acked, now):
+        n = n_acked.astype(jnp.float32)
+        in_ss = row.cwnd < row.ssthresh
+        fresh_epoch = row.cc_epoch == 0
+        epoch = jnp.where(fresh_epoch, now, row.cc_epoch)
+        # origin: W_max if we're below it (concave ascent back to it),
+        # else the current cwnd (convex probe; K = 0)
+        origin = jnp.where(
+            fresh_epoch, jnp.maximum(row.cc_wmax, row.cwnd), row.cc_wmax
+        )
+        cwnd0 = jnp.minimum(row.cwnd, origin)  # epoch-start estimate
+        k = jnp.cbrt(jnp.maximum(origin - cwnd0, 0.0) / cls.C)
+        srtt_s = jnp.maximum(row.srtt, MILLISECOND).astype(jnp.float32) * 1e-9
+        t = (now - epoch).astype(jnp.float32) * 1e-9 + srtt_s
+        target = cls.C * (t - k) ** 3 + origin
+        # reno-equivalent window since the epoch started (RFC 8312 W_est
+        # rebased at the epoch-start cwnd, not beta*W_max, so a no-loss
+        # epoch is never slower than reno)
+        friendly = cwnd0 + (
+            3.0 * (1.0 - cls.BETA) / (1.0 + cls.BETA)
+        ) * (t / srtt_s)
+        target = jnp.maximum(target, friendly)
+        # per-ack growth toward the target, capped at slow-start pace
+        inc = jnp.minimum(
+            jnp.maximum(target - row.cwnd, 0.0)
+            / jnp.maximum(row.cwnd, 1.0) * n,
+            n,
+        )
+        cwnd = jnp.where(in_ss, row.cwnd + n, row.cwnd + inc)
+        return (
+            cwnd,
+            jnp.where(in_ss, row.cc_wmax, origin),
+            jnp.where(in_ss, row.cc_epoch, epoch),
+        )
+
+    @classmethod
+    def on_loss(cls, row, flight, now):
+        # fast convergence: if below the previous W_max, remember less
+        wmax = jnp.where(
+            row.cwnd < row.cc_wmax,
+            row.cwnd * (2.0 - cls.BETA) / 2.0,
+            row.cwnd,
+        )
+        ss = jnp.maximum(row.cwnd * cls.BETA, 2.0)
+        return ss + 3, ss, wmax, jnp.zeros_like(row.cc_epoch)
+
+    @classmethod
+    def on_timeout(cls, row, flight, now):
+        ss = jnp.maximum(row.cwnd * cls.BETA, 2.0)
+        return ss, row.cwnd, jnp.zeros_like(row.cc_epoch)
+
+
+CC_ALGOS = {c.name: c for c in (RenoCC, AimdCC, CubicCC)}
 
 
 def _ts_us(now):
@@ -306,24 +520,35 @@ class TCP:
     inline_budget: segments sent inline from the ACK-processing path.
     auto_close: a connection reaching CLOSE_WAIT closes itself (the typical
       sim-server behavior; apps may instead close explicitly).
+    cc: congestion-control algorithm name ('reno'|'cubic'|'aimd'; the
+      reference's --tcp-congestion-control, options.c) or a hook class.
+    delack: delayed-ACK (reference tcp.c delack) — on by default, as in
+      the reference.
+    in_order: app deliveries surface bytes only as rcv_nxt advances
+      (strict byte-stream order) instead of on arrival.
 
     Engine `max_emit` must be >= `min_max_emit(app_rows)` where app_rows is
     the number of Emit rows the installed on_recv callback returns.
     """
 
     def __init__(self, tx_burst: int = 4, inline_budget: int = 2,
-                 auto_close: bool = True):
+                 auto_close: bool = True, cc="reno", delack: bool = True,
+                 in_order: bool = False, autotune: bool = True):
         self.tx_burst = tx_burst
         self.inline_budget = inline_budget
         self.auto_close = auto_close
+        self.cc = CC_ALGOS[cc] if isinstance(cc, str) else cc
+        self.delack = delack
+        self.in_order = in_order
+        self.autotune = autotune
 
     def min_max_emit(self, app_rows: int = 1) -> int:
         """Smallest EngineConfig.max_emit that fits this TCP's handlers.
 
         process_segment emits [ctl, retx] + inline_budget data rows +
-        [kick, timer] plus the on_recv callback's rows (>= 1, since
-        on_recv must return an Emit)."""
-        return max(self.tx_burst + 2, self.inline_budget + 4 + app_rows)
+        [kick, rto-timer, delack-timer] plus the on_recv callback's rows
+        (>= 1, since on_recv must return an Emit); _on_timer emits 4."""
+        return max(self.tx_burst + 2, self.inline_budget + 5 + app_rows, 4)
 
     # ------------------------------------------------------------ helpers
     def _seg_row(self, nic_tx, row, now, dst_host, sport, dport, s, is_fin,
@@ -625,25 +850,39 @@ class TCP:
         dup_acks = jnp.where(advanced, 0, row.dup_acks + is_dup.astype(_I32))
         fr = is_dup & (dup_acks == 3) & ~in_rec
         flight = (row.snd_nxt - row.snd_una).astype(jnp.float32)
-        ssthresh_fr = jnp.maximum(flight / 2, 2.0)
         exit_rec = advanced & in_rec & (ack >= row.recover)
         partial_ack = advanced & in_rec & ~exit_rec
-        grow = jnp.where(
-            row.cwnd < row.ssthresh,
-            row.cwnd + n_acked,
-            row.cwnd + n_acked / jnp.maximum(row.cwnd, 1.0),
+        cw_ack, wmax_ack, epoch_ack = self.cc.on_ack(row, n_acked, now)
+        # congestion-window validation: a window/app-limited flow must not
+        # inflate cwnd past what it actually uses (else a later loss cuts
+        # from a fictitious height) — growth is capped at 2x the flight
+        cw_ack = jnp.minimum(
+            cw_ack,
+            jnp.maximum(
+                jnp.maximum(flight * 2, row.cwnd), jnp.float32(INIT_CWND)
+            ),
+        )
+        cw_loss, ss_loss, wmax_loss, epoch_loss = self.cc.on_loss(
+            row, flight, now
         )
         cwnd = jnp.where(
-            fr, ssthresh_fr + 3,
+            fr, cw_loss,
             jnp.where(
                 is_dup & in_rec, row.cwnd + 1,
                 jnp.where(
                     exit_rec, row.ssthresh,
-                    jnp.where(advanced & ~in_rec, grow, row.cwnd),
+                    jnp.where(advanced & ~in_rec, cw_ack, row.cwnd),
                 ),
             ),
         )
         cwnd = jnp.minimum(cwnd, CWND_MAX)
+        grow_ack = advanced & ~in_rec
+        cc_wmax = jnp.where(
+            fr, wmax_loss, jnp.where(grow_ack, wmax_ack, row.cc_wmax)
+        )
+        cc_epoch = jnp.where(
+            fr, epoch_loss, jnp.where(grow_ack, epoch_ack, row.cc_epoch)
+        )
         retx = fr | partial_ack
         snd_una = jnp.where(advanced, ack, row.snd_una)
         n_segs = _n_segs(row.snd_buf)
@@ -665,7 +904,9 @@ class TCP:
             snd_una=snd_una,
             snd_nxt=jnp.maximum(row.snd_nxt, snd_una),
             cwnd=cwnd,
-            ssthresh=jnp.where(fr, ssthresh_fr, row.ssthresh),
+            ssthresh=jnp.where(fr, ss_loss, row.ssthresh),
+            cc_wmax=cc_wmax,
+            cc_epoch=cc_epoch,
             dup_acks=dup_acks,
             recover=jnp.where(
                 fr, row.snd_nxt, jnp.where(exit_rec, -1, row.recover)
@@ -680,32 +921,66 @@ class TCP:
             is_tcp & ~f_syn & ((pkt.length > 0) | f_fin)
             & (row.state >= ESTABLISHED)
         )
+        wnd_words = row.ooo.shape[0]
+        wnd_cap = 64 * wnd_words
         off = pkt.seq - row.rcv_nxt
-        in_win = (off >= 0) & (off < RCV_WND)
+        in_win = (off >= 0) & (off < wnd_cap)
         bit = jnp.where(
-            in_win, jnp.uint64(1) << jnp.clip(off, 0, 63).astype(jnp.uint64),
-            jnp.uint64(0),
+            in_win, _bit_vec(jnp.maximum(off, 0), wnd_words), jnp.uint64(0)
         )
-        already = (off < 0) | ((row.ooo & bit) != 0)
+        already = (off < 0) | (
+            in_win & _bit_test(row.ooo, jnp.maximum(off, 0))
+        )
         fresh = has_seg & in_win & ~already
         refill = (
             has_seg & ~fresh & (pkt.length > 0)
             & (pkt.seq == row.partial_seq) & (pkt.length > row.partial_len)
         )
-        new_bytes = (
-            jnp.where(fresh, pkt.length, 0)
-            + jnp.where(refill, pkt.length - row.partial_len, 0)
-        ).astype(_I32)
         ooo1 = jnp.where(fresh, row.ooo | bit, row.ooo)
-        adv = jnp.where(fresh, _trailing_ones(ooo1), 0)
+        adv = jnp.where(fresh, _trailing_ones_vec(ooo1), 0)
         rcv_nxt = row.rcv_nxt + adv
-        ooo2 = jnp.where(
-            adv >= 64, jnp.uint64(0),
-            ooo1 >> jnp.clip(adv, 0, 63).astype(jnp.uint64),
-        )
+        ooo2 = _shift_right_vec(ooo1, adv)
         is_partial = (
             has_seg & (pkt.length > 0) & (pkt.length < MSS) & (fresh | refill)
         )
+        if self.in_order:
+            # bytes surface only as rcv_nxt advances: adv full segments,
+            # corrected for partial segments inside the advanced range —
+            # the arriving one and/or the tracked outstanding partial —
+            # and for the FIN's sequence slot, which carries no data
+            new_bytes = adv * MSS
+            fin_seq = jnp.where(
+                has_seg & f_fin & (row.rfin_seq < 0), pkt.seq, row.rfin_seq
+            )
+            new_bytes -= jnp.where(
+                (fin_seq >= 0) & (fin_seq >= row.rcv_nxt)
+                & (fin_seq < rcv_nxt),
+                MSS, 0,
+            )
+            new_bytes -= jnp.where(
+                fresh & is_partial & (pkt.seq < rcv_nxt),
+                MSS - pkt.length, 0,
+            )
+            prev_partial_adv = (
+                (row.partial_seq >= row.rcv_nxt)
+                & (row.partial_seq < rcv_nxt) & (row.partial_seq != pkt.seq)
+            )
+            new_bytes -= jnp.where(
+                prev_partial_adv, MSS - row.partial_len, 0
+            )
+            # a refill for an already-advanced partial delivers its delta
+            # now; for a not-yet-advanced one the delta surfaces with the
+            # advance (partial_len below is updated either way)
+            new_bytes += jnp.where(
+                refill & (row.partial_seq < row.rcv_nxt),
+                pkt.length - row.partial_len, 0,
+            )
+            new_bytes = new_bytes.astype(_I32)
+        else:
+            new_bytes = (
+                jnp.where(fresh, pkt.length, 0)
+                + jnp.where(refill, pkt.length - row.partial_len, 0)
+            ).astype(_I32)
         clear_partial = (
             has_seg & (pkt.seq == row.partial_seq) & (pkt.length >= MSS)
         )
@@ -723,6 +998,36 @@ class TCP:
             ),
         ).astype(_I32)
         enter_tw = enter_tw_ack | (fin_new & (row.state == FIN_WAIT_2))
+        # -- receive-window autotuning (tcp.c:407-598): grow the advertised
+        # window toward the bitmap capacity when a round-trip's deliveries
+        # fill half of it. RTT is estimated from the packet timestamp's
+        # one-way delay (sim clocks are globally synchronous).
+        if self.autotune:
+            owd = jnp.maximum(
+                ((_ts_us(now) - pkt.aux) & 0x7FFFFFFF).astype(_I64) * 1000,
+                MILLISECOND,
+            )
+            ep_start = jnp.where(
+                row.rcv_ep_start > 0, row.rcv_ep_start, now
+            )
+            ep_segs = row.rcv_ep_segs + adv
+            ep_done = has_seg & (now - ep_start >= 2 * owd)
+            rwnd = jnp.where(
+                ep_done,
+                jnp.clip(2 * ep_segs, row.rwnd, row.rwnd_cap),
+                row.rwnd,
+            )
+            row = dataclasses.replace(
+                row,
+                rwnd=rwnd,
+                rcv_ep_segs=jnp.where(
+                    has_seg, jnp.where(ep_done, 0, ep_segs), row.rcv_ep_segs
+                ),
+                rcv_ep_start=jnp.where(
+                    has_seg, jnp.where(ep_done, now, ep_start),
+                    row.rcv_ep_start,
+                ),
+            )
         row = dataclasses.replace(
             row,
             state=state3,
@@ -746,7 +1051,25 @@ class TCP:
         row = dataclasses.replace(
             row, fin_pending=row.fin_pending | do_autoclose
         )
-        send_ack = has_seg | dup_syn
+        # -- delayed ACK (tcp.c delack): an in-order segment with no ACK
+        # debt outstanding waits for a second segment or the delack timer;
+        # anything out-of-order / duplicate / FIN-bearing ACKs immediately
+        # (the dup-ACK stream drives the peer's fast retransmit)
+        in_order_fresh = fresh & (off == 0)
+        delay_ok = (
+            jnp.asarray(self.delack) & has_seg & in_order_fresh & ~f_fin
+            & ~fin_new & (row.delack_segs == 0)
+        )
+        send_ack = (has_seg & ~delay_ok) | dup_syn
+        arm_delack = delay_ok & ~row.delack_live
+        row = dataclasses.replace(
+            row,
+            delack_segs=jnp.where(
+                has_seg, jnp.where(delay_ok, 1, 0), row.delack_segs
+            ),
+            delack_live=row.delack_live | arm_delack,
+            pend_echo=jnp.where(has_seg, pkt.aux, row.pend_echo),
+        )
 
         # -- retransmit row (fast retransmit / NewReno partial ack)
         nic_tx = net.nic_tx
@@ -765,6 +1088,14 @@ class TCP:
             is_tcp & ~do_open, unlimited,
         )
         kick = self._kick_row(c, now, nic_tx.free_at, more)
+        # outbound data/retransmit segments carry ack=rcv_nxt: the
+        # piggybacked ACK clears any delayed-ACK debt
+        sent_data = retx_row["mask"]
+        for r in data_rows:
+            sent_data = sent_data | r["mask"]
+        row = dataclasses.replace(
+            row, delack_segs=jnp.where(sent_data, 0, row.delack_segs)
+        )
 
         # -- control/ACK row: SYN-ACK (passive open / dup SYN), the
         # handshake-completing pure ACK, or a data/dup ACK
@@ -848,7 +1179,14 @@ class TCP:
         )
         pkt2 = dataclasses.replace(pkt, length=deliver_len, flags=eof_flags)
         hs, app_em = on_recv(hs, jnp.where(deliver, slot, -1), pkt2, now, key)
-        ours = _emit_from_rows([ctl, retx_row] + data_rows + [kick, timer_row])
+        da_row = dict(
+            dst=0, dt=jnp.int64(DELACK_DELAY), kind=KIND_TCP_TIMER,
+            args=_ctl_args(c, row.conn_gen, TK_DELACK), mask=arm_delack,
+            local=True,
+        )
+        ours = _emit_from_rows(
+            [ctl, retx_row] + data_rows + [kick, timer_row, da_row]
+        )
         return hs, emit_concat(ours, app_em)
 
     # ------------------------------------------------------ event handlers
@@ -885,8 +1223,20 @@ class TCP:
         gen = ev.args[T_GEN]
         tk = ev.args[T_KIND]
         row = _row(net.tcb, c)
-        live = (gen == row.timer_gen) & (net.sockets.proto[c] == PROTO_TCP)
+        slot_ok = net.sockets.proto[c] == PROTO_TCP
+        live = (gen == row.timer_gen) & slot_ok
         unlimited = now < stack.bootstrap_end
+
+        # delayed-ACK expiry: flush the owed ACK. The gen word carries the
+        # slot's connection incarnation, so a timer armed by a previous
+        # connection on a reused slot is inert for the new one
+        is_da = slot_ok & (tk == TK_DELACK) & (gen == row.conn_gen)
+        da_fire = is_da & (row.delack_segs > 0)
+        row = dataclasses.replace(
+            row,
+            delack_live=jnp.where(is_da, False, row.delack_live),
+            delack_segs=jnp.where(is_da, 0, row.delack_segs),
+        )
 
         # TIME_WAIT expiry: free the slot
         tw_done = live & (tk == TK_TIMEWAIT) & (row.state == TIME_WAIT)
@@ -896,13 +1246,14 @@ class TCP:
         fire = rto_ev & ~early
         outstanding = _outstanding(row)
         timeout = fire & outstanding
-        # timeout: collapse to loss state (reno timeout hook + go-back-N)
+        # timeout: collapse to loss state (cc timeout hook + go-back-N)
         flight = (row.snd_nxt - row.snd_una).astype(jnp.float32)
+        ss_to, wmax_to, epoch_to = self.cc.on_timeout(row, flight, now)
         row = dataclasses.replace(
             row,
-            ssthresh=jnp.where(
-                timeout, jnp.maximum(flight / 2, 2.0), row.ssthresh
-            ),
+            ssthresh=jnp.where(timeout, ss_to, row.ssthresh),
+            cc_wmax=jnp.where(timeout, wmax_to, row.cc_wmax),
+            cc_epoch=jnp.where(timeout, epoch_to, row.cc_epoch),
             cwnd=jnp.where(timeout, 1.0, row.cwnd),
             dup_acks=jnp.where(timeout, 0, row.dup_acks),
             recover=jnp.where(timeout, -1, row.recover),
@@ -958,6 +1309,20 @@ class TCP:
             args=_ctl_args(c, row.timer_gen, TK_RTO),
             mask=rearm, local=True,
         )
+        # the flushed delayed ACK (echoes the delayed segment's timestamp)
+        nic3, _s3, fin_t3 = nic_tx.admit(now, HEADER_TCP, unlimited)
+        nic_tx = jax.tree.map(
+            lambda n, o: jnp.where(da_fire, n, o), nic3, nic_tx
+        )
+        da_ack_row = dict(
+            dst=peer_h, dt=jnp.where(da_fire, fin_t3 - now, 0),
+            kind=KIND_PKT_ARRIVE,
+            args=_pkt_args(
+                sport, peer_p, seq=0, ack=row.rcv_nxt, length=0,
+                wnd=row.rwnd, aux=row.pend_echo, flags=F_ACK,
+            ),
+            mask=da_fire, local=False,
+        )
 
         # free on TIME_WAIT expiry
         row = jax.tree.map(
@@ -973,14 +1338,14 @@ class TCP:
                 jnp.where(tw_done, PROTO_NONE, net.sockets.proto[c])
             ),
         )
-        tcb = _write_row(net.tcb, c, row, live)
+        tcb = _write_row(net.tcb, c, row, live | is_da)
         hs = dataclasses.replace(
             hs,
             net=dataclasses.replace(
                 net, tcb=tcb, nic_tx=nic_tx, sockets=sockets
             ),
         )
-        return hs, _emit_from_rows([data_row, hs_row, timer_row])
+        return hs, _emit_from_rows([data_row, hs_row, timer_row, da_ack_row])
 
     def make_handlers(self, stack):
         """[KIND_TCP_TIMER, KIND_TCP_TX] handlers (appended after the

@@ -104,14 +104,66 @@ def test_process_stoptime_stops_emissions():
 
 
 def test_unimplemented_attrs_hard_error():
-    for attr, msg in [
-        ('interfacebuffer="1048576"', "interfacebuffer"),
-        ('socketsendbuffer="1048576"', "socketsendbuffer"),
-        ('logpcap="true"', "pcap"),
-    ]:
-        xml = phold_cfg(host_extra=attr)
-        with pytest.raises(ValueError, match=msg):
-            build_simulation(parse_config(xml))
+    # jitted app models cannot block on a full send buffer, so the knob
+    # must reject rather than silently not limit anything
+    xml = phold_cfg(host_extra='socketsendbuffer="1048576"')
+    with pytest.raises(ValueError, match="socketsendbuffer"):
+        build_simulation(parse_config(xml))
+
+
+def test_interfacebuffer_bounds_receive_queue():
+    """interfacebuffer drop-tails the implicit NIC receive queue
+    (options.c:132 'interface receive buffer'): a bulk transfer into a
+    slow receiver with a tiny buffer must shed packets; the default
+    megabyte buffer must not (CoDel acts first)."""
+    def run(attr):
+        xml = textwrap.dedent(f"""\
+        <shadow stoptime="40">
+          <topology><![CDATA[{topo()}]]></topology>
+          <plugin id="tgen" path="tgen"/>
+          <host id="server" bandwidthdown="128" {attr}>
+            <process plugin="tgen" starttime="1" arguments="server port=80"/>
+          </host>
+          <host id="client">
+            <process plugin="tgen" starttime="2"
+              arguments="peers=server:80 sendsize=200KiB recvsize=1KiB count=1"/>
+          </host>
+        </shadow>""")
+        sim = build_simulation(parse_config(xml), seed=3)
+        sim.strict_overflow = False
+        st = sim.run()
+        return int(st.hosts.net.nic_rx.drops.sum())
+
+    assert run('interfacebuffer="3000"') > 0
+    assert run("") == 0
+
+
+@pytest.mark.parametrize("qdisc", ["fifo", "rr"])
+@pytest.mark.parametrize("rx_queue", ["codel", "static", "single"])
+def test_qdisc_router_queue_matrix(qdisc, rx_queue):
+    """Every interface-qdisc x router-queue combination must carry a
+    2-client TGen exchange to completion (options.c interface-qdisc;
+    router.c:50-55 queue managers)."""
+    xml = textwrap.dedent(f"""\
+    <shadow stoptime="60">
+      <topology><![CDATA[{topo()}]]></topology>
+      <plugin id="tgen" path="tgen"/>
+      <host id="server">
+        <process plugin="tgen" starttime="1" arguments="server port=80"/>
+      </host>
+      <host id="client" quantity="2">
+        <process plugin="tgen" starttime="2"
+          arguments="peers=server:80 sendsize=20KiB recvsize=4KiB count=1"/>
+      </host>
+    </shadow>""")
+    sim = build_simulation(
+        parse_config(xml), seed=2, qdisc=qdisc, rx_queue=rx_queue,
+    )
+    sim.strict_overflow = False
+    st = sim.run()
+    assert [int(x) for x in st.hosts.app.streams_done[1:]] == [1, 1], (
+        qdisc, rx_queue,
+    )
 
 
 def test_socketrecvbuffer_caps_advertised_window():

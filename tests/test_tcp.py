@@ -70,8 +70,8 @@ def build(total=100_000, reply=0, latency=10 * MILLISECOND, bw=1024.0,
 
     handlers = stack.make_handlers(on_recv) + [on_app, on_app2]
     cfg = EngineConfig(
-        n_hosts=n_hosts, capacity=256, lookahead=latency, max_emit=8,
-        n_args=N_PKT_ARGS, seed=seed,
+        n_hosts=n_hosts, capacity=256, lookahead=latency,
+        max_emit=tcp.min_max_emit(2), n_args=N_PKT_ARGS, seed=seed,
     )
     eng = Engine(cfg, handlers, ConstantNetwork(latency, reliability))
 
