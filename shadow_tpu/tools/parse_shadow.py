@@ -26,13 +26,20 @@ NODE_FIELDS = (
     "bytes_wire_recv", "bytes_wire_send",
     "packets_recv", "packets_send",
     "bytes_header_recv", "bytes_header_send",
-    "retrans_segments", "events_executed", "queue_drops",
+    "retrans_segments", "events_executed", "queue_drops", "tail_drops",
+)
+
+
+RAM_FIELDS = (
+    "queue_slots_used", "queue_capacity", "sockets_used",
+    "sockets_capacity", "state_bytes",
 )
 
 
 def parse_lines(lines) -> dict:
     nodes: dict[str, dict] = {}
     sockets: dict[str, list] = {}
+    ram: dict[str, dict] = {}
     for line in lines:
         if "[shadow-heartbeat] [node] " in line:
             csv = line.rsplit("[shadow-heartbeat] [node] ", 1)[1].strip()
@@ -65,7 +72,18 @@ def parse_lines(lines) -> dict:
                     "retrans_segments": int(parts[9]),
                 }
             )
-    return {"nodes": nodes, "sockets": sockets}
+        elif "[shadow-heartbeat] [ram] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [ram] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 2 + len(RAM_FIELDS):
+                continue
+            node = ram.setdefault(
+                parts[1], {"ticks": [], **{f: [] for f in RAM_FIELDS}}
+            )
+            node["ticks"].append(int(parts[0]))
+            for f, v in zip(RAM_FIELDS, parts[2:]):
+                node[f].append(int(v))
+    return {"nodes": nodes, "sockets": sockets, "ram": ram}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,144 @@
+"""plot_shadow: stats.shadow_tpu.json -> summary plots.
+
+The reference's plot-shadow.py (src/tools/plot-shadow.py, 1252 lines of
+matplotlib) renders per-node time series and distributions from
+parse-shadow output. This is its lean shadow_tpu counterpart: one PNG per
+figure — aggregate throughput (wire bytes/s in and out), per-node
+cumulative goodput, packet and retransmission rates, and event-execution
+rates — from the JSON emitted by shadow_tpu.tools.parse_shadow.
+
+Usage:
+    python -m shadow_tpu.tools.plot_shadow stats.shadow_tpu.json [-o DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _series(node: dict, field: str) -> tuple[list, list]:
+    """(ticks, per-interval values) — heartbeat fields are interval
+    deltas already (utils/tracker.py emits per-interval counts)."""
+    return node.get("ticks", []), node.get(field, [])
+
+
+def make_figures(stats: dict, outdir: str, fmt: str = "png") -> list[str]:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    nodes = stats.get("nodes", {})
+    written: list[str] = []
+
+    def save(fig, name):
+        path = os.path.join(outdir, f"{name}.{fmt}")
+        fig.tight_layout()
+        fig.savefig(path, dpi=120)
+        plt.close(fig)
+        written.append(path)
+
+    # 1. aggregate wire throughput
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    agg: dict[int, list[float]] = {}
+    for direction, field in (("recv", "bytes_wire_recv"),
+                             ("send", "bytes_wire_send")):
+        totals: dict[int, int] = {}
+        interval = None
+        for node in nodes.values():
+            ticks, deltas = _series(node, field)
+            if len(ticks) > 1 and interval is None:
+                interval = ticks[1] - ticks[0]
+            for t, d in zip(ticks, deltas):
+                totals[t] = totals.get(t, 0) + d
+        if totals:
+            xs = sorted(totals)
+            iv = max(interval or 1, 1)
+            ax.plot(xs, [totals[x] / iv / 1024 for x in xs],
+                    label=f"wire {direction}")
+    ax.set_xlabel("sim time (s)")
+    ax.set_ylabel("KiB/s")
+    ax.set_title("aggregate wire throughput")
+    ax.legend()
+    save(fig, "shadow_tpu.throughput")
+
+    # 2. per-node cumulative payload received (top 20 by total)
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    ranked = sorted(
+        nodes.items(),
+        key=lambda kv: sum(kv[1].get("bytes_payload_recv") or [0]),
+        reverse=True,
+    )[:20]
+    for name, node in ranked:
+        ticks = node.get("ticks", [])
+        vals = node.get("bytes_payload_recv", [])
+        cum, run = [], 0
+        for v in vals:
+            run += v
+            cum.append(run / 1024)
+        if ticks:
+            ax.plot(ticks, cum, label=name, alpha=0.7)
+    ax.set_xlabel("sim time (s)")
+    ax.set_ylabel("cumulative payload recv (KiB)")
+    ax.set_title("per-node goodput (top 20)")
+    if len(ranked) <= 10:
+        ax.legend(fontsize=7)
+    save(fig, "shadow_tpu.goodput")
+
+    # 3. packet + retransmission rates
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    for field, label in (("packets_recv", "packets in"),
+                         ("packets_send", "packets out"),
+                         ("retrans_segments", "retransmits")):
+        totals = {}
+        for node in nodes.values():
+            ticks, deltas = _series(node, field)
+            for t, d in zip(ticks, deltas):
+                totals[t] = totals.get(t, 0) + d
+        if totals:
+            xs = sorted(totals)
+            ax.plot(xs, [totals[x] for x in xs], label=label)
+    ax.set_xlabel("sim time (s)")
+    ax.set_ylabel("count / interval")
+    ax.set_title("packets and retransmissions")
+    ax.set_yscale("symlog")
+    ax.legend()
+    save(fig, "shadow_tpu.packets")
+
+    # 4. event execution rate
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    totals = {}
+    for node in nodes.values():
+        ticks, deltas = _series(node, "events_executed")
+        for t, d in zip(ticks, deltas):
+            totals[t] = totals.get(t, 0) + d
+    if totals:
+        xs = sorted(totals)
+        ax.plot(xs, [totals[x] for x in xs])
+    ax.set_xlabel("sim time (s)")
+    ax.set_ylabel("events / interval")
+    ax.set_title("simulation event rate")
+    save(fig, "shadow_tpu.events")
+
+    return written
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("stats", help="stats.shadow_tpu.json from parse_shadow")
+    ap.add_argument("-o", "--output-dir", default=".")
+    ap.add_argument("--format", default="png", choices=["png", "pdf", "svg"])
+    args = ap.parse_args(argv)
+    with open(args.stats) as f:
+        stats = json.load(f)
+    os.makedirs(args.output_dir, exist_ok=True)
+    for path in make_figures(stats, args.output_dir, args.format):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

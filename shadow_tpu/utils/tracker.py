@@ -23,12 +23,19 @@ NODE_HEADER = (
     "[shadow-heartbeat] [node-header] time-seconds,name,"
     "recv-bytes,send-bytes,recv-wire-bytes,send-wire-bytes,"
     "recv-packets,send-packets,recv-header-bytes,send-header-bytes,"
-    "retrans-segments,events-executed,queue-drops"
+    "retrans-segments,events-executed,queue-drops,tail-drops"
 )
 SOCKET_HEADER = (
     "[shadow-heartbeat] [socket-header] time-seconds,name,slot,"
     "protocol,local-port,peer-host,peer-port,recv-bytes,send-bytes,"
     "retrans-segments"
+)
+# the reference's [ram] line tracks per-host allocation; the device-array
+# analog is occupancy of the host's fixed-capacity state rows
+RAM_HEADER = (
+    "[shadow-heartbeat] [ram-header] time-seconds,name,"
+    "queue-slots-used,queue-capacity,sockets-used,sockets-capacity,"
+    "state-bytes"
 )
 
 
@@ -45,11 +52,12 @@ class Snapshot:
     retx: np.ndarray  # [H] retransmitted segments
     events: np.ndarray  # [H]
     drops: np.ndarray  # [H]
+    tail_drops: np.ndarray  # [H] NIC receive-buffer drop-tail losses
 
     @staticmethod
     def zero(n: int) -> "Snapshot":
         z = lambda: np.zeros((n,), np.int64)
-        return Snapshot(z(), z(), z(), z(), z(), z(), z(), z(), z())
+        return Snapshot(z(), z(), z(), z(), z(), z(), z(), z(), z(), z())
 
 
 def snapshot(st) -> Snapshot:
@@ -71,6 +79,7 @@ def snapshot(st) -> Snapshot:
         retx=retx,
         events=np.array(jax.device_get(st.stats.n_executed)),
         drops=np.array(jax.device_get(st.queues.drops)).astype(np.int64),
+        tail_drops=np.array(jax.device_get(net.nic_rx.drops)),
     )
 
 
@@ -108,6 +117,8 @@ class Tracker:
             self.logger.log(sim_ns, "tracker", "message", NODE_HEADER)
             if any_socket:
                 self.logger.log(sim_ns, "tracker", "message", SOCKET_HEADER)
+            if any("ram" in self._info(n) for n in self.names):
+                self.logger.log(sim_ns, "tracker", "message", RAM_HEADER)
             self._emitted_headers = True
         t_s = sim_ns // 1_000_000_000
         p = self.prev
@@ -128,11 +139,42 @@ class Tracker:
                 f"{max(rxw - rx, 0)},{max(txw - tx, 0)},"
                 f"{cur.retx[i] - p.retx[i]},"
                 f"{cur.events[i] - p.events[i]},"
-                f"{cur.drops[i] - p.drops[i]}",
+                f"{cur.drops[i] - p.drops[i]},"
+                f"{cur.tail_drops[i] - p.tail_drops[i]}",
             )
         if any_socket:
             self._socket_lines(st, sim_ns, t_s)
+        if any("ram" in self._info(n) for n in self.names):
+            self._ram_lines(st, sim_ns, t_s)
         self.prev = cur
+
+    def _ram_lines(self, st, sim_ns: int, t_s: int) -> None:
+        """Per-host state occupancy (the reference's [ram] allocation
+        heartbeat, tracker.c ram section, reinterpreted for fixed-width
+        device arrays: used slots vs capacity plus the per-host share of
+        the resident state bytes)."""
+        import math
+
+        q_time = np.array(jax.device_get(st.queues.time))
+        used = (q_time < np.iinfo(np.int64).max).sum(axis=1)
+        cap = q_time.shape[1]
+        proto = np.array(jax.device_get(st.hosts.net.sockets.proto))
+        s_used = (proto != 0).sum(axis=1)
+        s_cap = proto.shape[1]
+        n = len(self.names)
+        state_bytes = sum(
+            math.prod(l.shape) * l.dtype.itemsize
+            for l in jax.tree.leaves(st)
+        ) // max(n, 1)
+        for i, name in enumerate(self.names):
+            if "ram" not in self._info(name):
+                continue
+            self.logger.log(
+                sim_ns, name, self._level(name),
+                "[shadow-heartbeat] [ram] "
+                f"{t_s},{name},{used[i]},{cap},{s_used[i]},{s_cap},"
+                f"{state_bytes}",
+            )
 
     def _socket_lines(self, st, sim_ns: int, t_s: int) -> None:
         net = st.hosts.net

@@ -106,3 +106,47 @@ def test_cli_emits_parseable_heartbeats(capsys):
     assert "server" in stats["nodes"] and "client" in stats["nodes"]
     summary = json.loads(out.splitlines()[-1])
     assert summary["rx_bytes"] > 0
+
+
+def test_plot_shadow_renders_figures(tmp_path):
+    """plot_shadow turns parse_shadow stats into figure files
+    (the reference's plot-shadow.py consuming stats.shadow.json)."""
+    sim = build_simulation(parse_config(CFG), seed=7)
+    buf = io.StringIO()
+    lg = ShadowLogger(stream=buf)
+    tr = Tracker(sim.names, lg, log_info=("node",))
+    st = sim.state0
+    for t_s in (10, 20, 30, 40):
+        st = sim.run(t_s * 1_000_000_000, state=st)
+        tr.heartbeat(st, t_s * 1_000_000_000)
+    lg.flush()
+    stats = parse_lines(buf.getvalue().splitlines())
+
+    from shadow_tpu.tools.plot_shadow import make_figures
+
+    paths = make_figures(stats, str(tmp_path))
+    assert len(paths) == 4
+    import os
+
+    for p in paths:
+        assert os.path.getsize(p) > 1000  # real rendered PNGs
+
+
+def test_ram_heartbeat_lines():
+    """The [ram] heartbeat class (tracker.c ram section): per-host state
+    occupancy lines parse back and report sane capacities."""
+    sim = build_simulation(parse_config(CFG), seed=7)
+    buf = io.StringIO()
+    lg = ShadowLogger(stream=buf)
+    tr = Tracker(sim.names, lg, log_info=("node", "ram"))
+    st = sim.run(20 * 1_000_000_000)
+    tr.heartbeat(st, 20 * 1_000_000_000)
+    lg.flush()
+    stats = parse_lines(buf.getvalue().splitlines())
+    ram = stats["ram"]
+    assert set(ram) == {"server", "client"}
+    r = ram["server"]
+    assert r["queue_capacity"][0] == 512
+    assert r["sockets_capacity"][0] == 8
+    assert 0 < r["sockets_used"][0] <= 8
+    assert r["state_bytes"][0] > 1000
