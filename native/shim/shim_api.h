@@ -43,6 +43,23 @@ typedef struct ShimAPI {
 
     /* simtime-tagged logging through the runtime */
     void (*log_msg)(void* ctx, const char* msg);
+
+    /* pipes (channel.c:22-33 linked byte-queue pair, host-local):
+     * rfd reads what wfd writes; closing wfd EOFs rfd */
+    int (*pipe2)(void* ctx, int* rfd, int* wfd);
+
+    /* timerfd (timer.c:23-42): armed absolute-from-now + interval;
+     * timer_read blocks until >=1 expiration and returns the count */
+    int (*timer_create)(void* ctx);
+    int (*timer_settime)(void* ctx, int fd, int64_t first_ns,
+                         int64_t interval_ns);
+    int64_t (*timer_read)(void* ctx, int fd);            /* blocks */
+
+    /* poll over shim fds (epoll.c/poll emulation, process_emu_poll):
+     * returns a readiness bitmask (bit i = fds[i] readable/acceptable/
+     * expired), 0 on timeout; timeout_ns < 0 waits forever */
+    int (*poll_fds)(void* ctx, const int* fds, int nfds,
+                    int64_t timeout_ns);                 /* blocks */
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

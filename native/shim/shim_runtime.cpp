@@ -51,6 +51,8 @@ enum ReqOp : int32_t {
     REQ_SLEEP = 5,
     REQ_EXIT = 6,
     REQ_LOG = 7,
+    REQ_TIMER = 8, /* a0 = absolute deadline ns, a1 = interval ns (0=one
+                      shot); fd = timer fd */
 };
 
 enum CompOp : int32_t {
@@ -58,6 +60,7 @@ enum CompOp : int32_t {
     COMP_CONNECT_FAIL = 2,
     COMP_ACCEPT = 3, /* r0 = new fd (driver-chosen) */
     COMP_WAKE = 4,
+    COMP_TIMER = 5, /* fd = timer fd, r0 = expirations to credit */
 };
 
 enum BlockKind : int32_t {
@@ -66,6 +69,8 @@ enum BlockKind : int32_t {
     BLK_ACCEPT = 2,
     BLK_RECV = 3,
     BLK_SLEEP = 4,
+    BLK_TIMER = 5,
+    BLK_POLL = 6,
 };
 
 } // namespace
@@ -79,6 +84,7 @@ struct ShimReq {
     int32_t fd;
     int32_t port;
     int64_t a0;
+    int64_t a1;
     char name[64];
 };
 
@@ -101,6 +107,11 @@ struct Endpoint {
     bool fin_rx = false;
     bool closed = false;
     bool listening = false;
+    int pipe_peer = -1;  /* pipes: the other end's fd (same proc) */
+    bool is_pipe = false;
+    bool is_timer = false;
+    int64_t expirations = 0; /* timerfd credit awaiting timer_read */
+    int32_t timer_gen = 0;   /* arm generation: stale COMP_TIMERs ignored */
 };
 
 struct Proc {
@@ -119,6 +130,9 @@ struct Proc {
     void* block_buf = nullptr;
     int64_t block_result = 0;
     bool comp_ready = false;
+    std::vector<int> poll_set; /* fds a BLK_POLL thread waits on */
+    int32_t wake_gen = 0; /* sleep/poll-timeout generation: a wake for an
+                             abandoned earlier block must not fire */
 
     std::map<int, Endpoint> fds;
     int next_fd = kFirstFd;
@@ -141,13 +155,14 @@ struct Runtime {
 thread_local Runtime* g_rt = nullptr;
 
 void push_req(Runtime* rt, int32_t pid, int32_t op, int32_t fd, int32_t port,
-              int64_t a0, const char* name) {
+              int64_t a0, const char* name, int64_t a1 = 0) {
     ShimReq r{};
     r.pid = pid;
     r.op = op;
     r.fd = fd;
     r.port = port;
     r.a0 = a0;
+    r.a1 = a1;
     if (name) {
         snprintf(r.name, sizeof(r.name), "%s", name);
     }
@@ -214,6 +229,16 @@ int64_t api_send(void* vctx, int fd, const void* buf, int64_t n) {
     Proc* p = rt->current;
     auto it = p->fds.find(fd);
     if (it == p->fds.end() || it->second.closed || n < 0) return -1;
+    if (it->second.is_pipe) {
+        /* pipes are host-local byte queues (channel.c:22-33): bytes land
+         * on the read end immediately, no device round trip. A closed
+         * read end is EPIPE (-1), the reference's broken-pipe path */
+        auto peer = p->fds.find(it->second.pipe_peer);
+        if (peer == p->fds.end() || peer->second.closed) return -1;
+        peer->second.inbuf.append(static_cast<const char*>(buf),
+                                  static_cast<size_t>(n));
+        return n;
+    }
     it->second.outbuf.append(static_cast<const char*>(buf),
                              static_cast<size_t>(n));
     push_req(rt, p->pid, REQ_SEND, fd, 0, n, nullptr);
@@ -244,6 +269,17 @@ int api_close(void* vctx, int fd) {
     auto it = p->fds.find(fd);
     if (it == p->fds.end()) return -1;
     it->second.closed = true;
+    if (it->second.is_pipe) {
+        auto peer = p->fds.find(it->second.pipe_peer);
+        if (peer != p->fds.end()) peer->second.fin_rx = true;
+        return 0;
+    }
+    if (it->second.is_timer) {
+        /* disarm so the driver drops the periodic entry */
+        int32_t gen = ++it->second.timer_gen;
+        push_req(rt, p->pid, REQ_TIMER, fd, gen, -1, nullptr, 0);
+        return 0;
+    }
     push_req(rt, p->pid, REQ_CLOSE, fd, 0, 0, nullptr);
     return 0;
 }
@@ -256,7 +292,8 @@ int api_sleep_ns(void* vctx, int64_t ns) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
     if (ns <= 0) return 0;
-    push_req(rt, p->pid, REQ_SLEEP, -1, 0, rt->now_ns + ns, nullptr);
+    push_req(rt, p->pid, REQ_SLEEP, -1, ++p->wake_gen, rt->now_ns + ns,
+             nullptr);
     block_here(rt, p, BLK_SLEEP, -1, 0, nullptr);
     return 0;
 }
@@ -264,6 +301,98 @@ int api_sleep_ns(void* vctx, int64_t ns) {
 void api_log(void* vctx, const char* msg) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     push_req(rt, rt->current->pid, REQ_LOG, -1, 0, 0, msg);
+}
+
+int api_pipe2(void* vctx, int* rfd, int* wfd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    int r = p->next_fd++;
+    int w = p->next_fd++;
+    Endpoint& re = p->fds[r];
+    Endpoint& we = p->fds[w];
+    re.is_pipe = we.is_pipe = true;
+    re.pipe_peer = w;
+    we.pipe_peer = r;
+    *rfd = r;
+    *wfd = w;
+    return 0;
+}
+
+int api_timer_create(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    int fd = p->next_fd++;
+    p->fds[fd].is_timer = true;
+    return fd;
+}
+
+int api_timer_settime(void* vctx, int fd, int64_t first_ns,
+                      int64_t interval_ns) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.is_timer || first_ns < 0)
+        return -1;
+    it->second.expirations = 0;
+    int32_t gen = ++it->second.timer_gen; /* retires any previous arm */
+    if (first_ns == 0 && interval_ns == 0) {
+        /* timerfd_settime disarm: tell the driver so the dead arm stops
+         * bounding window sizes */
+        push_req(rt, p->pid, REQ_TIMER, fd, gen, -1, nullptr, 0);
+        return 0;
+    }
+    push_req(rt, p->pid, REQ_TIMER, fd, gen, rt->now_ns + first_ns,
+             nullptr, interval_ns);
+    return 0;
+}
+
+int64_t api_timer_read(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.is_timer) return -1;
+    while (it->second.expirations == 0) {
+        block_here(rt, p, BLK_TIMER, fd, 0, nullptr);
+        it = p->fds.find(fd);
+        if (it == p->fds.end()) return -1;
+    }
+    int64_t n = it->second.expirations;
+    it->second.expirations = 0;
+    return n;
+}
+
+bool fd_ready(Proc* p, int fd) {
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return true; /* error -> surface immediately */
+    const Endpoint& e = it->second;
+    if (e.is_timer) return e.expirations > 0;
+    return !e.inbuf.empty() || e.fin_rx || !e.accept_queue.empty();
+}
+
+int api_poll_fds(void* vctx, const int* fds, int nfds, int64_t timeout_ns) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    if (nfds <= 0 || nfds > 31) return -1;
+
+    auto mask_of = [&]() {
+        int m = 0;
+        for (int i = 0; i < nfds; i++)
+            if (fd_ready(p, fds[i])) m |= 1 << i;
+        return m;
+    };
+    int m = mask_of();
+    if (m || timeout_ns == 0) return m;
+    p->poll_set.assign(fds, fds + nfds);
+    if (timeout_ns > 0) {
+        push_req(rt, p->pid, REQ_SLEEP, -1, ++p->wake_gen,
+                 rt->now_ns + timeout_ns, nullptr);
+    }
+    block_here(rt, p, BLK_POLL, -1, 0, nullptr);
+    /* a timeout wake left unconsumed (poll satisfied by readiness) must
+     * not fire into a later sleep/poll: retire this generation */
+    p->wake_gen++;
+    p->poll_set.clear();
+    return mask_of();
 }
 
 ShimAPI make_api(Runtime* rt) {
@@ -279,6 +408,11 @@ ShimAPI make_api(Runtime* rt) {
     a.time_ns = api_time_ns;
     a.sleep_ns = api_sleep_ns;
     a.log_msg = api_log;
+    a.pipe2 = api_pipe2;
+    a.timer_create = api_timer_create;
+    a.timer_settime = api_timer_settime;
+    a.timer_read = api_timer_read;
+    a.poll_fds = api_poll_fds;
     return a;
 }
 
@@ -308,6 +442,17 @@ bool runnable(const Proc* p) {
             auto it = p->fds.find(p->block_fd);
             if (it == p->fds.end()) return true; /* error path */
             return !it->second.inbuf.empty() || it->second.fin_rx;
+        }
+        case BLK_TIMER: {
+            auto it = p->fds.find(p->block_fd);
+            if (it == p->fds.end()) return true;
+            return it->second.expirations > 0;
+        }
+        case BLK_POLL: {
+            if (p->comp_ready) return true; /* poll timeout fired */
+            for (int fd : p->poll_set)
+                if (fd_ready(const_cast<Proc*>(p), fd)) return true;
+            return false;
         }
     }
     return false;
@@ -437,8 +582,22 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
                 break;
             }
             case COMP_WAKE:
-                if (p->blocked_on == BLK_SLEEP) p->comp_ready = true;
+                /* r0 carries the wake generation from the REQ_SLEEP; a
+                 * wake for an abandoned block (poll satisfied early) is
+                 * stale and must not fire into a later sleep/poll */
+                if ((p->blocked_on == BLK_SLEEP || p->blocked_on == BLK_POLL)
+                    && static_cast<int32_t>(c.r0) == p->wake_gen)
+                    p->comp_ready = true;
                 break;
+            case COMP_TIMER: {
+                /* pad carries the arm generation; credits for a re-armed
+                 * or closed timer are stale */
+                auto it = p->fds.find(c.fd);
+                if (it != p->fds.end() && it->second.is_timer
+                    && c.pad == it->second.timer_gen)
+                    it->second.expirations += c.r0;
+                break;
+            }
         }
     }
 

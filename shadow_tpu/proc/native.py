@@ -18,8 +18,9 @@ _SHIM_DIR = os.path.join(_REPO_ROOT, "native", "shim")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
 REQ_LISTEN, REQ_CONNECT, REQ_SEND, REQ_CLOSE = 1, 2, 3, 4
-REQ_SLEEP, REQ_EXIT, REQ_LOG = 5, 6, 7
+REQ_SLEEP, REQ_EXIT, REQ_LOG, REQ_TIMER = 5, 6, 7, 8
 COMP_CONNECT_OK, COMP_CONNECT_FAIL, COMP_ACCEPT, COMP_WAKE = 1, 2, 3, 4
+COMP_TIMER = 5
 
 
 class ShimReq(ctypes.Structure):
@@ -29,6 +30,7 @@ class ShimReq(ctypes.Structure):
         ("fd", ctypes.c_int32),
         ("port", ctypes.c_int32),
         ("a0", ctypes.c_int64),
+        ("a1", ctypes.c_int64),
         ("name", ctypes.c_char * 64),
     ]
 
@@ -136,10 +138,12 @@ class ShimRuntime:
         self._lib.shim_start(self._rt, pid)
 
     def pump(self, now_ns: int, comps: list[tuple]) -> list[ShimReq]:
-        """comps: [(pid, op, fd, r0)] -> emitted requests."""
+        """comps: [(pid, op, fd, r0[, pad])] -> emitted requests."""
         carr = (ShimComp * max(len(comps), 1))()
-        for i, (pid, op, fd, r0) in enumerate(comps):
+        for i, c in enumerate(comps):
+            pid, op, fd, r0 = c[:4]
             carr[i].pid, carr[i].op, carr[i].fd, carr[i].r0 = pid, op, fd, r0
+            carr[i].pad = c[4] if len(c) > 4 else 0
         n = self._lib.shim_pump(
             self._rt, now_ns, carr, len(comps), self._req_buf, self._max_reqs
         )

@@ -57,9 +57,11 @@ from shadow_tpu.proc.native import (
     REQ_CONNECT,
     REQ_EXIT,
     REQ_LISTEN,
+    COMP_TIMER,
     REQ_LOG,
     REQ_SEND,
     REQ_SLEEP,
+    REQ_TIMER,
     ShimRuntime,
 )
 from shadow_tpu.sim import build_simulation
@@ -76,13 +78,16 @@ class ProcessTier:
     """
 
     def __init__(self, cfg: ShadowConfig, *, seed: int = 0,
-                 n_sockets: int = 8, capacity: int = 256,
-                 strict_overflow: bool = True):
+                 n_sockets: int = 8, capacity: int | None = None,
+                 strict_overflow: bool = True, tcp_cc: str = "reno",
+                 rx_queue: str = "codel", qdisc: str = "fifo",
+                 interface_buffer: int = 1_024_000):
         self.strict_overflow = strict_overflow
         self.model = ProcTierModel()
         self.sim = build_simulation(
             cfg, seed=seed, n_sockets=n_sockets, capacity=capacity,
-            app_model=self.model,
+            app_model=self.model, tcp_cc=tcp_cc, rx_queue=rx_queue,
+            qdisc=qdisc, interface_buffer=interface_buffer,
         )
         if self.sim.mesh is not None:
             raise NotImplementedError("ProcessTier is single-shard for now")
@@ -103,7 +108,12 @@ class ProcessTier:
         self._next_sport: dict[int, int] = {}
         self._next_fd: dict[int, int] = {}
         self._starts: list[tuple[int, int]] = []  # (t_ns, pid) heap
-        self._wakes: list[tuple[int, int]] = []
+        self._wakes: list[tuple[int, int, int]] = []  # (t_ns, pid, gen)
+        # timerfd arms: (deadline_ns, pid, fd, interval_ns, gen) heap;
+        # _timer_gen holds each fd's current arm generation so re-armed
+        # or closed timers' stale entries retire on pop
+        self._timers: list[tuple[int, int, int, int, int]] = []
+        self._timer_gen: dict[tuple[int, int], int] = {}
         self._pending_comps: list[tuple] = []
         self._push_jit = jax.jit(queue_push, static_argnames=())
 
@@ -187,7 +197,14 @@ class ProcessTier:
                     gid, slot = self.slot_of[key]
                     rows.append((gid, [CMD_CLOSE, slot]))
             elif r.op == REQ_SLEEP:
-                heapq.heappush(self._wakes, (int(r.a0), pid))
+                heapq.heappush(self._wakes, (int(r.a0), pid, int(r.port)))
+            elif r.op == REQ_TIMER:
+                gen = int(r.port)
+                self._timer_gen[(pid, fd)] = gen
+                if int(r.a0) >= 0:  # a0 = -1 is a disarm
+                    heapq.heappush(
+                        self._timers, (int(r.a0), pid, fd, int(r.a1), gen)
+                    )
             elif r.op == REQ_LOG:
                 self.logs.append((now, pid, r.name.decode()))
             elif r.op == REQ_EXIT:
@@ -320,8 +337,22 @@ class ProcessTier:
                 _, pid = heapq.heappop(self._starts)
                 self.rt.start(pid)
             while self._wakes and self._wakes[0][0] <= now:
-                _, pid = heapq.heappop(self._wakes)
-                comps.append((pid, COMP_WAKE, -1, 0))
+                _, pid, gen = heapq.heappop(self._wakes)
+                comps.append((pid, COMP_WAKE, -1, gen))
+            while self._timers and self._timers[0][0] <= now:
+                t, pid, fd, interval, gen = heapq.heappop(self._timers)
+                if self._timer_gen.get((pid, fd)) != gen:
+                    continue  # re-armed or closed since: stale
+                if interval > 0:
+                    # credit every expiration the window skipped over and
+                    # re-arm on the absolute grid (timer.c interval
+                    # expirations with no drift)
+                    n_exp = (now - t) // interval + 1
+                    heapq.heappush(
+                        self._timers, (t + n_exp * interval, pid, fd,
+                                       interval, gen)
+                    )
+                comps.append((pid, COMP_TIMER, fd, int(n_exp if interval > 0 else 1), gen))
 
             reqs = self.rt.pump(now, comps)
             st = self._inject(st, self._translate(reqs, now), now)
@@ -334,6 +365,14 @@ class ProcessTier:
                 bound = min(bound, max(self._starts[0][0], now + 1))
             if self._wakes:
                 bound = min(bound, max(self._wakes[0][0], now + 1))
+            # retire re-armed/disarmed timer entries so a dead arm stops
+            # bounding window sizes
+            while self._timers and self._timer_gen.get(
+                (self._timers[0][1], self._timers[0][2])
+            ) != self._timers[0][4]:
+                heapq.heappop(self._timers)
+            if self._timers:
+                bound = min(bound, max(self._timers[0][0], now + 1))
             st = sim.step_window(st, bound)
             now = int(jax.device_get(st.now))
             self._observe(st)

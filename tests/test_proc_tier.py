@@ -126,3 +126,37 @@ def test_echo_pair_sleep_and_time(plugin):
     assert tier.exit_codes == {0: 0, 1: 0}, (tier.exit_codes, tier.logs)
     tier.close()
     os.remove(src)
+
+
+def clock_config(plugin_path: str, interval_ms: int, ticks: int) -> str:
+    return textwrap.dedent(f"""\
+    <shadow stoptime="30">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="shim_clock" path="{plugin_path}"/>
+      <host id="clocker">
+        <process plugin="shim_clock" starttime="1"
+          arguments="{interval_ms} {ticks}"/>
+      </host>
+    </shadow>""")
+
+
+@pytest.fixture(scope="module")
+def clock_plugin():
+    from shadow_tpu.proc.native import compile_plugin
+
+    return compile_plugin(os.path.join(REPO, "native/plugins/shim_clock.c"))
+
+
+def test_timerfd_pipe_poll_surface(clock_plugin):
+    """Descriptor-layer syscalls (timer.c / channel.c / poll emulation):
+    a periodic timer drives pipe round-trips under poll; every check is
+    inside the plugin (exit 0 = timers on the virtual-time grid, pipe
+    bytes intact, poll masks and timeout correct, EOF on close)."""
+    from shadow_tpu.proc import ProcessTier
+
+    cfg = parse_config(clock_config(clock_plugin, interval_ms=200, ticks=5))
+    tier = ProcessTier(cfg, seed=1)
+    tier.run()
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, tier.logs)
+    assert any("clock done: 5 ticks" in m for _, _, m in tier.logs)
+    tier.close()
