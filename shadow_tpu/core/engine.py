@@ -205,17 +205,38 @@ class Engine:
     """
 
     def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network,
-                 cpu_cost=None):
+                 cpu_cost=None, batch_handler=None):
         """`cpu_cost`: optional i64[H] per-event virtual-CPU nanoseconds
         (the reference's per-host CPU model delays event execution while
         the virtual CPU is busy — cpu.c:56-107, event.c:75-84). None or
-        zeros disables the model with no overhead in results."""
+        zeros disables the model with no overhead in results.
+
+        `batch_handler`: optional commutative fast path. When set, the
+        window drain executes each host's whole below-barrier frontier in
+        ONE vmapped call instead of one sequential step per event:
+        `batch_handler(host_state_slice, evs: Events with [B]-leading
+        fields, keys[B]) -> (host_state_slice', Emit with [B, K] fields)`.
+        Only valid when (a) the state transition commutes across the
+        events of one window (order-insensitive folds like counters), and
+        (b) handlers never emit local events below the window barrier —
+        both hold for PHOLD-style models. Per-position RNG keys derive
+        from (gid, exec_cnt + position), so results remain deterministic
+        and sharding-independent. Incompatible with the CPU model (which
+        is inherently sequential per host)."""
         self.cfg = cfg
         self.handlers = tuple(handlers)
         self.network = network
+        self.batch_handler = batch_handler
         self._base_key = srng.root_key(cfg.seed)
         if cpu_cost is None:
             cpu_cost = jnp.zeros((cfg.n_hosts,), jnp.int64)
+        elif batch_handler is not None and jnp.any(
+            jnp.asarray(cpu_cost) != 0
+        ):
+            raise ValueError(
+                "batch_handler (commutative drain) cannot be combined "
+                "with the per-host CPU model"
+            )
         self.cpu_cost = jnp.asarray(cpu_cost, jnp.int64)
         # jitter rolls cost an extra uniform per emit row; skip them
         # entirely for jitter-free networks
@@ -330,6 +351,64 @@ class Engine:
             cpu_free=jnp.zeros((cfg.n_hosts,), jnp.int64),
         )
 
+    # -- shared emit routing -------------------------------------------------
+    def _route(self, emit: Emit, base_time, gids, window_end, rkeys, emask,
+               seq):
+        """Route an [N, K] emit batch: local tasks keep their time;
+        network sends add path latency (+jitter), roll reliability, and
+        clamp to the window barrier (worker_sendPacket semantics,
+        worker.c:243-304; self-addressed sends traverse the topology
+        self-loop like any other packet).
+
+        Returns (Events[N, K], final_mask, dropped, t, is_local)."""
+        n, k = emit.dst.shape
+        self_gid = gids[:, None]
+        is_local = emit.local
+        dst = jnp.where(is_local, self_gid, emit.dst)
+        dt = jnp.maximum(emit.dt, 0)
+        lat, rel, jit = self.network.route(
+            jnp.broadcast_to(self_gid, (n, k)), dst
+        )
+
+        def roll(key, kidx):
+            return jax.random.uniform(jax.random.fold_in(key, kidx))
+
+        def rolls(offset):
+            return jax.vmap(
+                lambda key: jax.vmap(lambda i: roll(key, i))(
+                    jnp.arange(k, dtype=jnp.uint32) + offset
+                )
+            )(rkeys)
+
+        if self._use_jitter:
+            # seeded symmetric latency noise, per packet (the reference
+            # carries per-edge jitter attrs, topology.c:101-105; paths
+            # accumulate them like latency)
+            uj = rolls(jnp.uint32(k))
+            lat = jnp.maximum(
+                lat + ((uj * 2.0 - 1.0) * jit.astype(jnp.float32)).astype(
+                    jnp.int64
+                ),
+                0,
+            )
+        t = base_time[:, None] + dt
+        t_remote = jnp.maximum(t + lat, window_end)
+        t = jnp.where(is_local, t, t_remote)
+
+        u = rolls(jnp.uint32(0))
+        dropped = (~is_local) & (u >= rel) & emask
+        final_mask = emask & ~dropped
+
+        out = Events(
+            time=jnp.where(final_mask, t, TIME_INVALID),
+            dst=dst,
+            src=jnp.broadcast_to(self_gid, (n, k)).astype(jnp.int32),
+            seq=seq,
+            kind=emit.kind,
+            args=emit.args,
+        )
+        return out, final_mask, dropped, t, is_local
+
     # -- execute one frontier position across all hosts ---------------------
     def _execute_step(self, hosts, src_seq, exec_cnt, stats, ev: Events,
                       active: jax.Array, window_end: jax.Array, gids: jax.Array):
@@ -367,53 +446,8 @@ class Engine:
         seq = src_seq[:, None] + within
         src_seq = src_seq + jnp.sum(inc, axis=1, dtype=jnp.int32)
 
-        # route: local tasks keep their time; network sends add latency,
-        # roll reliability, and clamp to the window barrier (self-addressed
-        # sends traverse the topology self-loop like any other packet)
-        self_gid = gids[:, None]
-        is_local = emit.local
-        dst = jnp.where(is_local, self_gid, emit.dst)
-        dt = jnp.maximum(emit.dt, 0)
-        lat, rel, jit = self.network.route(
-            jnp.broadcast_to(self_gid, (h, k)), dst
-        )
-
-        def roll(key, kidx):
-            return jax.random.uniform(jax.random.fold_in(key, kidx))
-
-        def rolls(offset):
-            return jax.vmap(
-                lambda key: jax.vmap(lambda i: roll(key, i))(
-                    jnp.arange(k, dtype=jnp.uint32) + offset
-                )
-            )(rkeys)
-
-        if self._use_jitter:
-            # seeded symmetric latency noise, per packet (the reference
-            # carries per-edge jitter attrs, topology.c:101-105; paths
-            # accumulate them like latency)
-            uj = rolls(jnp.uint32(k))
-            lat = jnp.maximum(
-                lat + ((uj * 2.0 - 1.0) * jit.astype(jnp.float32)).astype(
-                    jnp.int64
-                ),
-                0,
-            )
-        t = ev.time[:, None] + dt
-        t_remote = jnp.maximum(t + lat, window_end)
-        t = jnp.where(is_local, t, t_remote)
-
-        u = rolls(jnp.uint32(0))
-        dropped = (~is_local) & (u >= rel) & emask
-        final_mask = emask & ~dropped
-
-        out = Events(
-            time=jnp.where(final_mask, t, TIME_INVALID),
-            dst=dst,
-            src=jnp.broadcast_to(self_gid, (h, k)).astype(jnp.int32),
-            seq=seq,
-            kind=emit.kind,
-            args=emit.args,
+        out, final_mask, dropped, t, is_local = self._route(
+            emit, ev.time, gids, window_end, rkeys, emask, seq
         )
         local_below = jnp.where(
             final_mask & is_local & (t < window_end), t, TIME_INVALID
@@ -428,8 +462,102 @@ class Engine:
         )
         return hosts, src_seq, exec_cnt, stats, out, final_mask, local_below
 
+    # -- commutative fast path: whole frontiers in one vmapped call ---------
+    def _drain_window_batched(self, st: EngineState, window_end, host0):
+        """Window drain for batch_handler engines: every below-barrier
+        frontier event executes in a single [H, B]-shaped handler call
+        per sweep — no sequential inner loop. Valid only under the
+        batch_handler contract (commutative state folds, no local
+        below-barrier emits); per-position keys keep determinism."""
+        cfg = self.cfg
+        h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
+        b = max(1, min(cfg.drain_batch, c))
+        gids = host0 + jnp.arange(h, dtype=jnp.int32)
+
+        def outer_cond(carry):
+            q = carry[0]
+            return self._gany(jnp.any(q.min_time() < window_end))
+
+        def outer_body(carry):
+            q, hosts, src_seq, exec_cnt, stats = carry
+            bt = q.time[:, :b]
+            bvalid = bt < window_end  # a prefix: rows are key-sorted
+            evs = Events(
+                time=jnp.where(bvalid, bt, TIME_INVALID),
+                dst=jnp.broadcast_to(gids[:, None], (h, b)),
+                src=q.src[:, :b],
+                seq=q.seq[:, :b],
+                kind=q.kind[:, :b],
+                args=q.args[:, :b],
+            )
+            cnts = exec_cnt[:, None] + jnp.arange(b, dtype=jnp.int32)[None, :]
+            hk, rk = srng.event_keys(
+                self._base_key,
+                jnp.broadcast_to(gids[:, None], (h, b)).reshape(-1),
+                cnts.reshape(-1),
+            )
+            hk = hk.reshape((h, b))
+
+            hosts2, emit = jax.vmap(self.batch_handler)(hosts, evs, hk)
+            n_exec = jnp.sum(bvalid, axis=1, dtype=jnp.int32)
+            hosts = _select_rows(n_exec > 0, hosts2, hosts)
+            emask = emit.mask & bvalid[:, :, None]
+
+            # dense per-source sequence numbers across the [B, K] lanes
+            inc = emask.astype(jnp.int32).reshape(h, b * k)
+            within = jnp.cumsum(inc, axis=1) - inc
+            seq = (src_seq[:, None] + within).reshape(h, b, k)
+            src_seq = src_seq + jnp.sum(inc, axis=1, dtype=jnp.int32)
+
+            flat = lambda a: a.reshape((h * b,) + a.shape[2:])
+            em_flat = jax.tree.map(flat, emit)
+            out, final_mask, dropped, _t, _loc = self._route(
+                em_flat,
+                evs.time.reshape(-1),
+                jnp.broadcast_to(gids[:, None], (h, b)).reshape(-1),
+                window_end,
+                rk,
+                flat(emask),
+                flat(seq),
+            )
+
+            exec_cnt = exec_cnt + n_exec
+            stats2 = dataclasses.replace(
+                stats,
+                n_executed=stats.n_executed + n_exec,
+                n_emitted=stats.n_emitted
+                + jnp.sum(inc, axis=1, dtype=jnp.int64),
+                n_net_dropped=stats.n_net_dropped
+                + jnp.sum(
+                    dropped.reshape(h, b * k), axis=1, dtype=jnp.int64
+                ),
+            )
+            cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < n_exec[:, None]
+            q = dataclasses.replace(
+                q, time=jnp.where(cleared, TIME_INVALID, q.time)
+            )
+            q = self._exchange_push(
+                q, out.flatten(), final_mask.reshape(-1), host0
+            )
+            return (q, hosts, src_seq, exec_cnt, stats2)
+
+        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats)
+        q, hosts, src_seq, exec_cnt, stats = jax.lax.while_loop(
+            outer_cond, outer_body, carry
+        )
+        return dataclasses.replace(
+            st,
+            queues=q,
+            hosts=hosts,
+            src_seq=src_seq,
+            exec_cnt=exec_cnt,
+            stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
+        )
+
     # -- window = drain all events below the barrier ------------------------
     def _drain_window(self, st: EngineState, window_end, host0):
+        if self.batch_handler is not None:
+            return self._drain_window_batched(st, window_end, host0)
         cfg = self.cfg
         h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
         b = max(1, min(cfg.drain_batch, c))

