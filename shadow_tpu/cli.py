@@ -78,6 +78,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=0,
                    help="shard hosts over N devices (0 = single device; "
                         "the TPU-era --workers)")
+    p.add_argument("--dcn-slices", type=int, default=1,
+                   help="arrange the mesh as M slices joined over DCN "
+                        "(multi-slice; the reference's unfinished "
+                        "multi-machine design, master.c:414-416)")
     p.add_argument("--workers", "-w", type=int, default=None,
                    help="ignored (pthread-era flag; kept for compatibility)")
     p.add_argument("--scheduler-policy", "-p", default=None,
@@ -208,10 +212,14 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     mesh = None
+    if args.dcn_slices > 1 and not args.mesh:
+        print("error: --dcn-slices needs --mesh N (total devices across "
+              "all slices)", file=sys.stderr)
+        return 2
     if args.mesh:
         from shadow_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(args.mesh)
+        mesh = make_mesh(args.mesh, dcn_slices=args.dcn_slices)
     sim = build_simulation(
         cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity,
         mesh=mesh, tcp_cc=args.tcp_congestion_control,

@@ -3,11 +3,18 @@
 The reference assigns hosts to worker pthreads by random shuffle
 (reference: src/main/core/scheduler/scheduler.c:440-534) and synchronizes
 rounds with 6 countdown-latch barriers (scheduler.c:124-129). Here hosts are
-block-partitioned across a 1-D `jax.sharding.Mesh` axis ("hosts" — the
-data-parallel axis of this framework); every engine state leaf is sharded on
-its leading host dimension; the round barrier is `lax.pmin` and cross-shard
-packet delivery rides XLA collectives over ICI (SURVEY.md §2.4
+block-partitioned across a `jax.sharding.Mesh`; every engine state leaf is
+sharded on its leading host dimension; the round barrier is `lax.pmin` and
+cross-shard packet delivery rides XLA collectives over ICI (SURVEY.md §2.4
 "Distributed communication backend").
+
+Multi-slice: the mesh may be 2-D ("dcn", "hosts") — slices of chips joined
+over the data-center network, the reference's never-finished multi-machine
+master/slave design (master.c:414-416, work/message.c stub) done properly.
+Hosts block-partition over both axes (dcn-major); every collective
+(pmin barrier, bucketed all_to_all exchange) runs over the combined axis
+tuple, so XLA routes intra-slice traffic over ICI and inter-slice traffic
+over DCN.
 """
 
 from __future__ import annotations
@@ -18,9 +25,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 HOSTS_AXIS = "hosts"
+DCN_AXIS = "dcn"
 
 
-def make_mesh(n_devices: int | None = None, axis: str = HOSTS_AXIS) -> Mesh:
+def make_mesh(n_devices: int | None = None, axis: str = HOSTS_AXIS,
+              dcn_slices: int = 1) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
         if len(devs) < n_devices:
@@ -29,7 +38,24 @@ def make_mesh(n_devices: int | None = None, axis: str = HOSTS_AXIS) -> Mesh:
                 f"(set --xla_force_host_platform_device_count for CPU testing)"
             )
         devs = devs[:n_devices]
+    if dcn_slices > 1:
+        n = len(devs)
+        if n % dcn_slices:
+            raise ValueError(
+                f"{n} devices not divisible by {dcn_slices} DCN slices"
+            )
+        return Mesh(
+            np.array(devs).reshape(dcn_slices, n // dcn_slices),
+            (DCN_AXIS, axis),
+        )
     return Mesh(np.array(devs), (axis,))
+
+
+def hosts_axes(mesh: Mesh):
+    """The axis name (1-D mesh) or axis-name tuple (multi-slice mesh)
+    hosts are sharded over — valid anywhere an axis_name is accepted."""
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
 
 
 def state_specs(st, n_hosts_local: int, axis: str = HOSTS_AXIS):

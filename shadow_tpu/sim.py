@@ -146,17 +146,18 @@ class Simulation:
             return jax.jit(lambda st, stop: fn(st, stop, 0))
         from jax.sharding import PartitionSpec as P
 
-        from shadow_tpu.parallel.mesh import HOSTS_AXIS, state_specs
+        from shadow_tpu.parallel.mesh import hosts_axes, state_specs
 
+        axes = hosts_axes(self.mesh)
         per = self.engine.cfg.n_hosts
         # state0 leaves are global-shaped; sharding splits the leading
-        # host dim across the axis
+        # host dim across the axis (or axis tuple for multi-slice)
         specs = state_specs(
-            self.state0, per * self.engine.cfg.n_shards, HOSTS_AXIS
+            self.state0, per * self.engine.cfg.n_shards, axes
         )
 
         def sharded(st, stop):
-            host0 = jax.lax.axis_index(HOSTS_AXIS).astype(jnp.int32) * per
+            host0 = jax.lax.axis_index(axes).astype(jnp.int32) * per
             return fn(st, stop, host0)
 
         return jax.jit(
@@ -586,13 +587,15 @@ def build_simulation(
 
     lookahead = max(int(topo.min_latency_ms * MILLISECOND), 1)
     if mesh is not None:
+        from shadow_tpu.parallel.mesh import hosts_axes
+
         n_shards = int(mesh.devices.size)
         if n_hosts % n_shards:
             raise ValueError(
                 f"{n_hosts} hosts not divisible by mesh size {n_shards}"
             )
         per_shard = n_hosts // n_shards
-        axis_name = _hosts_axis()
+        axis_name = hosts_axes(mesh)
     else:
         n_shards, per_shard, axis_name = 1, n_hosts, None
     ecfg = EngineConfig(
@@ -646,12 +649,13 @@ def build_simulation(
         # ignores out-of-shard destinations)
         from jax.sharding import PartitionSpec as P
 
-        from shadow_tpu.parallel.mesh import HOSTS_AXIS, state_specs
+        from shadow_tpu.parallel.mesh import hosts_axes, state_specs
 
-        hspecs = jax.tree.map(lambda _: P(HOSTS_AXIS), hosts_state)
+        axes = hosts_axes(mesh)
+        hspecs = jax.tree.map(lambda _: P(axes), hosts_state)
 
         def init_shard(hslice):
-            host0 = jax.lax.axis_index(HOSTS_AXIS).astype(jnp.int32) * per_shard
+            host0 = jax.lax.axis_index(axes).astype(jnp.int32) * per_shard
             return eng.init_state(hslice, init, host0)
 
         slice_shapes = jax.tree.map(
@@ -663,7 +667,7 @@ def build_simulation(
         template = jax.eval_shape(
             lambda hs: eng.init_state(hs, init, 0), slice_shapes
         )
-        ospecs = state_specs(template, per_shard, HOSTS_AXIS)
+        ospecs = state_specs(template, per_shard, axes)
         st0 = jax.jit(
             jax.shard_map(
                 init_shard,
@@ -685,12 +689,6 @@ def build_simulation(
         pcap_gids=tuple(int(g) for g in np.nonzero(pcap_mask)[0]),
         pcap_dir=(pcap_dirs.pop() if pcap_dirs else "shadow.pcap.d"),
     )
-
-
-def _hosts_axis() -> str:
-    from shadow_tpu.parallel.mesh import HOSTS_AXIS
-
-    return HOSTS_AXIS
 
 
 def default_registry() -> dict[str, Callable]:

@@ -39,8 +39,8 @@ from shadow_tpu.host.nic import HEADER_TCP, HEADER_UDP, MTU, NIC, CoDel
 from shadow_tpu.host.sockets import PROTO_TCP, PROTO_UDP, SocketTable
 
 # ---------------------------------------------------------------------------
-# Packet arg layout: 9 i32 words.
-N_PKT_ARGS = 9
+# Packet arg layout: 11 i32 words.
+N_PKT_ARGS = 11
 A_META = 0  # proto | tcp flags (bit-packed, see below)
 A_SPORT = 1
 A_DPORT = 2
@@ -50,6 +50,8 @@ A_LEN = 5  # payload bytes
 A_WND = 6  # TCP: advertised receive window (segments)
 A_AUX = 7  # timestamp echo (ms) / app payload word
 A_SRC = 8  # original source host id (stashed across the local rx re-emit)
+A_SACK0 = 9  # TCP: SACK bitmap rel. to ack, bits 0-31 (tcp.c SACK list)
+A_SACK1 = 10  # TCP: SACK bitmap bits 32-63
 
 F_SYN = 1 << 2
 F_ACK = 1 << 3
@@ -76,6 +78,7 @@ class Pkt:
     length: jax.Array
     wnd: jax.Array
     aux: jax.Array
+    sack: jax.Array  # u64 bitmap: bit i = segment ack+i held by receiver
 
     @staticmethod
     def decode(ev: Events) -> "Pkt":
@@ -92,17 +95,27 @@ class Pkt:
             length=a[A_LEN],
             wnd=a[A_WND],
             aux=a[A_AUX],
+            sack=(
+                a[A_SACK0].astype(jnp.uint32).astype(jnp.uint64)
+                | (a[A_SACK1].astype(jnp.uint32).astype(jnp.uint64) << 32)
+            ),
         )
 
     @staticmethod
     def encode_args(proto, sport, dport, seq=0, ack=0, length=0, wnd=0,
-                    aux=0, flags=0):
+                    aux=0, flags=0, sack=0):
         """i32[N_PKT_ARGS] args vector for an Emit (scalar fields)."""
         meta = jnp.asarray(proto, jnp.int32) | jnp.asarray(flags, jnp.int32)
         mk = lambda x: jnp.broadcast_to(jnp.asarray(x, jnp.int32), meta.shape)
+        sack = jnp.asarray(sack, jnp.uint64)
+        s0 = (sack & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32).astype(jnp.int32)
+        s1 = (sack >> jnp.uint64(32)).astype(jnp.uint32).astype(jnp.int32)
+        meta = jnp.broadcast_to(
+            meta, jnp.broadcast_shapes(meta.shape, s0.shape)
+        )
         return jnp.stack(
             [meta, mk(sport), mk(dport), mk(seq), mk(ack), mk(length),
-             mk(wnd), mk(aux), mk(0)]
+             mk(wnd), mk(aux), mk(0), mk(s0), mk(s1)]
         )
 
 

@@ -50,8 +50,16 @@ Fidelity features (round 2):
   rcv_nxt advances — the byte-stream contract the real-binary tier needs;
   on-arrival counting remains the default for raw-engine users.
 
+- **SACK** (tcp.c SACK + the C++ retransmit tally's sacked/lost range
+  bookkeeping, tcp_retransmit_tally.cc): every ACK advertises the first
+  64 bits of the receiver's reassembly bitmap (relative to the ack);
+  the sender keeps a `sacked` scoreboard relative to snd_una and skips
+  sacked segments when refilling the window after a timeout's
+  go-back-N rewind — received data is never retransmitted. (The
+  reference caps its SACK list similarly; ranges beyond the 64-segment
+  horizon simply retransmit.)
+
 Remaining deliberate deviations:
-- NewReno without SACK scoreboard: partial ACKs retransmit snd_una.
 - A refilled partial segment is tracked for exactly one outstanding
   partial (the common request/response case); overlapping multiple
   partials under-deliver bytes to the app counter only.
@@ -169,6 +177,7 @@ class TCB:
     cc_wmax: jax.Array  # f32 cubic W_max (cwnd at last loss)
     cc_epoch: jax.Array  # i64 cubic epoch start (0 = unset)
     conn_gen: jax.Array  # i32 slot incarnation (stale-delack rejection)
+    sacked: jax.Array  # u64 SACK scoreboard: bit i = snd_una+i received
 
     @staticmethod
     def create(n_hosts: int, n_sockets: int, rcv_wnd=None,
@@ -217,6 +226,7 @@ class TCB:
             cc_wmax=jnp.zeros(s, jnp.float32),
             cc_epoch=zl,
             conn_gen=zi,
+            sacked=jnp.zeros(s, jnp.uint64),
         )
 
     def listen(self, host: int, slot: int) -> "TCB":
@@ -275,6 +285,7 @@ def _fresh_row_like(old: TCB) -> TCB:
         cc_wmax=jnp.float32(0.0),
         cc_epoch=jnp.int64(0),
         conn_gen=old.conn_gen + 1,
+        sacked=jnp.uint64(0),
     )
 
 
@@ -484,17 +495,20 @@ def _ts_us(now):
     return jnp.maximum((now // 1000) & 0x7FFFFFFF, 1).astype(_I32)
 
 
-def _pkt_args(sport, dport, seq=0, ack=0, length=0, wnd=RCV_WND, aux=0, flags=0):
+def _pkt_args(sport, dport, seq=0, ack=0, length=0, wnd=RCV_WND, aux=0,
+              flags=0, sack=0):
     return Pkt.encode_args(
         PROTO_TCP, sport, dport, seq=seq, ack=ack, length=length, wnd=wnd,
-        aux=aux, flags=flags,
+        aux=aux, flags=flags, sack=sack,
     )
 
 
 def _ctl_args(slot, gen_or_zero, tk=0):
     f = lambda x: jnp.asarray(x, _I32)
     z = jnp.int32(0)
-    return jnp.stack([f(slot), f(gen_or_zero), f(tk), z, z, z, z, z, z])
+    return jnp.stack(
+        [f(slot), f(gen_or_zero), f(tk)] + [z] * (N_PKT_ARGS - 3)
+    )
 
 
 def _emit_from_rows(rows):
@@ -562,7 +576,7 @@ class TCP:
         flags = F_ACK | jnp.where(is_fin, F_FIN, 0)
         args = _pkt_args(
             sport, dport, seq=s, ack=row.rcv_nxt, length=length,
-            wnd=row.rwnd, aux=_ts_us(now), flags=flags,
+            wnd=row.rwnd, aux=_ts_us(now), flags=flags, sack=row.ooo[0],
         )
         em = dict(
             dst=dst_host, dt=jnp.where(ok, fin_t - now, 0),
@@ -594,13 +608,23 @@ class TCP:
             s = nxt
             is_data = s < n_segs
             is_fin = row.fin_pending & ~is_data & (s == n_segs)
-            ok = can & (is_data | is_fin) & (s < row.snd_una + win) & (s < lim)
+            inwin = (s < row.snd_una + win) & (s < lim)
+            # SACK scoreboard: a segment the receiver already holds is
+            # skipped (nxt advances without a wire packet) — the whole
+            # point of the sacked/lost range bookkeeping the reference
+            # keeps in tcp_retransmit_tally.cc
+            s_rel = s - row.snd_una
+            is_sacked = is_data & (s_rel >= 0) & (s_rel < 64) & (
+                ((row.sacked >> jnp.clip(s_rel, 0, 63).astype(jnp.uint64))
+                 & jnp.uint64(1)) != 0
+            )
+            ok = can & (is_data | is_fin) & inwin & ~is_sacked
             nic_tx, em = self._seg_row(
                 nic_tx, row, now, dst_host, sport, dport, s, is_fin, ok,
                 unlimited,
             )
             rows.append(em)
-            nxt = nxt + ok.astype(_I32)
+            nxt = nxt + (ok | (can & is_sacked & inwin)).astype(_I32)
             sent_fin = sent_fin | (ok & is_fin)
         state = jnp.where(
             sent_fin & (row.state == ESTABLISHED), FIN_WAIT_1,
@@ -885,6 +909,18 @@ class TCP:
         )
         retx = fr | partial_ack
         snd_una = jnp.where(advanced, ack, row.snd_una)
+        # SACK scoreboard maintenance: realign to the new snd_una, then
+        # absorb the ACK's advertised bitmap (relative to its ack field,
+        # which equals the new snd_una whenever it is current)
+        shift = jnp.clip(snd_una - row.snd_una, 0, 63).astype(jnp.uint64)
+        sacked = jnp.where(
+            (snd_una - row.snd_una) >= 64, jnp.uint64(0),
+            row.sacked >> shift,
+        )
+        sacked = jnp.where(
+            ack_ok & (ack == snd_una), sacked | pkt.sack, sacked
+        )
+        row = dataclasses.replace(row, sacked=sacked)
         n_segs = _n_segs(row.snd_buf)
         fin_acked = row.fin_pending & (snd_una >= n_segs + 1)
         state2 = jnp.where(
@@ -1117,6 +1153,7 @@ class TCP:
             args=_pkt_args(
                 pkt.dst_port, pkt.src_port, seq=0, ack=ctl_ack, length=0,
                 wnd=row.rwnd, aux=ctl_aux, flags=ctl_flags,
+                sack=row.ooo[0],
             ),
             mask=need_ctl, local=False,
         )
@@ -1320,6 +1357,7 @@ class TCP:
             args=_pkt_args(
                 sport, peer_p, seq=0, ack=row.rcv_nxt, length=0,
                 wnd=row.rwnd, aux=row.pend_echo, flags=F_ACK,
+                sack=row.ooo[0],
             ),
             mask=da_fire, local=False,
         )

@@ -185,3 +185,17 @@ def test_cubic_beats_or_matches_reno_on_clean_path():
         return int(st.hosts.app.last_rx[1])
 
     assert finish("cubic") <= finish("reno") * 1.1
+
+
+def test_sack_limits_retransmissions():
+    """SACK scoreboard (tcp.c SACK; tcp_retransmit_tally.cc): under loss
+    the sender must never storm-retransmit a whole window — received
+    segments are skipped, so total retransmissions stay well below the
+    stream's segment count."""
+    total = 120_000
+    eng, st = build(total=total, reliability=0.85, seed=5)
+    st = jax.jit(eng.run)(st, jnp.int64(60 * SECOND))
+    assert int(st.hosts.app.rx.sum()) == total
+    n_segs = -(-total // tcpm.MSS)
+    retx = int(st.hosts.net.tcb.n_retx.sum())
+    assert 0 < retx < n_segs, (retx, n_segs)
