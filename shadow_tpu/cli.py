@@ -350,6 +350,15 @@ def main(argv=None) -> int:
         "tx_bytes": int(
             jax.device_get(st.hosts.net.sockets.tx_bytes.sum())
         ),
+        # the reference's ObjectCounter shutdown report
+        # (object_counter.c; slave.c:237-241)
+        "events_by_kind": {
+            name: int(n)
+            for name, n in zip(
+                sim.kind_names,
+                jax.device_get(stats.n_by_kind.sum(axis=0)),
+            )
+        },
     }
     print(json.dumps(summary))
     return 0

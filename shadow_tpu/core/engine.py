@@ -120,17 +120,23 @@ class Emit:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class Stats:
-    """Per-host accounting (the reference's ObjectCounter/Tracker spirit)."""
+    """Per-host accounting (the reference's ObjectCounter/Tracker spirit:
+    object_counter.c tracks new/free per object type; here every event
+    kind gets an executed count, the struct-of-arrays analog)."""
 
     n_executed: jax.Array  # i64[H]
     n_emitted: jax.Array  # i64[H]
     n_net_dropped: jax.Array  # i64[H] packets lost to reliability rolls
     n_windows: jax.Array  # i64[] (replicated across shards)
+    n_by_kind: jax.Array  # i64[H, NK] executed events per handler kind
 
     @staticmethod
-    def create(n_hosts: int) -> "Stats":
+    def create(n_hosts: int, n_kinds: int = 1) -> "Stats":
         z = jnp.zeros((n_hosts,), jnp.int64)
-        return Stats(z, z, z, jnp.zeros((), jnp.int64))
+        return Stats(
+            z, z, z, jnp.zeros((), jnp.int64),
+            jnp.zeros((n_hosts, n_kinds), jnp.int64),
+        )
 
 
 @jax.tree_util.register_dataclass
@@ -206,10 +212,13 @@ class Engine:
 
     def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network,
                  cpu_cost=None, batch_handler=None):
-        """`cpu_cost`: optional i64[H] per-event virtual-CPU nanoseconds
-        (the reference's per-host CPU model delays event execution while
-        the virtual CPU is busy — cpu.c:56-107, event.c:75-84). None or
-        zeros disables the model with no overhead in results.
+        """`cpu_cost`: optional i64[n_hosts * n_shards] per-event
+        virtual-CPU nanoseconds, indexed by GLOBAL host id (the
+        reference's per-host CPU model delays event execution while the
+        virtual CPU is busy — cpu.c:56-107, event.c:75-84). Global
+        indexing lets one engine closure serve every shard: each window
+        gathers its own hosts' costs by gid. None or zeros disables the
+        model with no overhead in results.
 
         `batch_handler`: optional commutative fast path. When set, the
         window drain executes each host's whole below-barrier frontier in
@@ -229,7 +238,7 @@ class Engine:
         self.batch_handler = batch_handler
         self._base_key = srng.root_key(cfg.seed)
         if cpu_cost is None:
-            cpu_cost = jnp.zeros((cfg.n_hosts,), jnp.int64)
+            cpu_cost = jnp.zeros((cfg.n_hosts * cfg.n_shards,), jnp.int64)
         elif batch_handler is not None and jnp.any(
             jnp.asarray(cpu_cost) != 0
         ):
@@ -238,6 +247,11 @@ class Engine:
                 "with the per-host CPU model"
             )
         self.cpu_cost = jnp.asarray(cpu_cost, jnp.int64)
+        if self.cpu_cost.shape != (cfg.n_hosts * cfg.n_shards,):
+            raise ValueError(
+                f"cpu_cost must cover all {cfg.n_hosts * cfg.n_shards} "
+                f"global hosts, got shape {self.cpu_cost.shape}"
+            )
         # jitter rolls cost an extra uniform per emit row; skip them
         # entirely for jitter-free networks
         self._use_jitter = bool(getattr(network, "has_jitter", False))
@@ -347,7 +361,7 @@ class Engine:
             hosts=hosts,
             src_seq=seq0,
             exec_cnt=jnp.zeros((cfg.n_hosts,), jnp.int32),
-            stats=Stats.create(cfg.n_hosts),
+            stats=Stats.create(cfg.n_hosts, len(self.handlers)),
             cpu_free=jnp.zeros((cfg.n_hosts,), jnp.int64),
         )
 
@@ -459,6 +473,13 @@ class Engine:
             n_executed=stats.n_executed + active,
             n_emitted=stats.n_emitted + jnp.sum(inc, axis=1, dtype=jnp.int64),
             n_net_dropped=stats.n_net_dropped + jnp.sum(dropped, axis=1, dtype=jnp.int64),
+            n_by_kind=stats.n_by_kind + (
+                jax.nn.one_hot(
+                    jnp.clip(ev.kind, 0, len(self.handlers) - 1),
+                    len(self.handlers), dtype=jnp.int64,
+                )
+                * active[:, None]
+            ),
         )
         return hosts, src_seq, exec_cnt, stats, out, final_mask, local_below
 
@@ -531,6 +552,14 @@ class Engine:
                 + jnp.sum(
                     dropped.reshape(h, b * k), axis=1, dtype=jnp.int64
                 ),
+                n_by_kind=stats.n_by_kind + jnp.sum(
+                    jax.nn.one_hot(
+                        jnp.clip(evs.kind, 0, len(self.handlers) - 1),
+                        len(self.handlers), dtype=jnp.int64,
+                    )
+                    * bvalid[:, :, None],
+                    axis=1,
+                ),
             )
             cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < n_exec[:, None]
             q = dataclasses.replace(
@@ -562,6 +591,7 @@ class Engine:
         h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
         b = max(1, min(cfg.drain_batch, c))
         gids = host0 + jnp.arange(h, dtype=jnp.int32)
+        cpu_cost = self.cpu_cost[gids]  # this shard's per-host costs
         i64max = jnp.iinfo(jnp.int64).max
 
         def outer_cond(carry):
@@ -621,7 +651,7 @@ class Engine:
                     hosts, src_seq, exec_cnt, stats, ev, active, window_end, gids
                 )
                 cpu_free = jnp.where(
-                    active & (self.cpu_cost > 0), eff_t + self.cpu_cost,
+                    active & (cpu_cost > 0), eff_t + cpu_cost,
                     cpu_free,
                 )
                 upd = lambda buf, x: jax.lax.dynamic_update_index_in_dim(buf, x, bi, 0)

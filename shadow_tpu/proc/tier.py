@@ -20,9 +20,10 @@ granularity (SURVEY.md §7 step 6b): once per window it
 
 Deviation from the reference, documented for the parity check: process
 reactions land at window boundaries (one lookahead of added latency per
-blocking syscall round trip), and byte-stream content assumes in-order
-delivery — exact on lossless paths, where the device TCP's on-arrival
-accounting is in-order.
+blocking syscall round trip). Byte-stream order is exact on lossy paths
+too: config-built simulations run the device TCP in strict in-order
+delivery mode (transport/tcp.py in_order), so the per-socket delivered
+counters this driver diffs only ever advance in stream order.
 """
 
 from __future__ import annotations

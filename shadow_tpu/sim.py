@@ -136,6 +136,7 @@ class Simulation:
     mesh: Any = None  # jax.sharding.Mesh when sharded
     pcap_gids: tuple = ()  # hosts with logpcap set
     pcap_dir: str = "shadow.pcap.d"  # from the pcapdir host attr
+    kind_names: tuple = ()  # handler-kind names (object-counter labels)
 
     _jit_run: Any = None
     _jit_step: Any = None
@@ -578,6 +579,22 @@ def build_simulation(
     base_handlers = stack.make_handlers(on_recv)
     kind_base = len(base_handlers)
     handlers = base_handlers + make_handlers(stack, kind_base)
+    # handler-kind labels for the per-kind executed-event counters (the
+    # reference's ObjectCounter type names, object_counter.h:13-27)
+    kind_names = ["pkt_arrive", "pkt_rx"]
+    if tcp is not None:
+        kind_names += ["tcp_timer", "tcp_tx"]
+    if isinstance(model, FusedModel):
+        for name, sub, _ in model.parts:
+            kind_names += [f"{name}.{i}" for i in range(sub.n_kinds)]
+    else:
+        kind_names += [f"{model.name}.{i}" for i in range(model.n_kinds)]
+    if len(kind_names) != len(handlers):
+        raise AssertionError(
+            f"kind label table ({len(kind_names)}) out of sync with the "
+            f"handler table ({len(handlers)}); update the names above "
+            "alongside Stack.make_handlers/model kinds"
+        )
 
     if tcp is not None:
         need = tcp.min_max_emit(model.app_rows())
@@ -604,11 +621,6 @@ def build_simulation(
         axis_name=axis_name, n_shards=n_shards,
     )
     network = topo.build_network(host_vertex)
-    if mesh is not None and cpu_cost.any():
-        raise NotImplementedError(
-            "cpufrequency with --mesh: per-shard CPU cost slicing is not "
-            "wired yet"
-        )
     eng = Engine(
         ecfg, handlers, network,
         cpu_cost=jnp.asarray(cpu_cost) if cpu_cost.any() else None,
@@ -688,6 +700,7 @@ def build_simulation(
         stack=stack, mesh=mesh,
         pcap_gids=tuple(int(g) for g in np.nonzero(pcap_mask)[0]),
         pcap_dir=(pcap_dirs.pop() if pcap_dirs else "shadow.pcap.d"),
+        kind_names=tuple(kind_names),
     )
 
 

@@ -187,3 +187,19 @@ def test_socketrecvbuffer_caps_advertised_window():
     st = sim.run()
     # the transfer still completes under the tiny window
     assert int(st.hosts.app.streams_done[1]) == 1
+
+def test_cpufrequency_works_sharded():
+    """The CPU model under a device mesh: global-gid cost indexing means
+    a sharded run matches the single-device run bit for bit."""
+    from shadow_tpu.parallel.mesh import make_mesh
+
+    slow_xml = phold_cfg(n=8).replace(
+        '<host id="peer" quantity="8" >',
+        '<host id="peer" quantity="8" cpufrequency="1000">',
+    )
+    cfg = parse_config(slow_xml)
+    st1 = build_simulation(cfg, seed=3).run()
+    stN = build_simulation(cfg, seed=3, mesh=make_mesh(4)).run()
+    assert st1.stats.n_executed.tolist() == stN.stats.n_executed.tolist()
+    assert st1.cpu_free.tolist() == stN.cpu_free.tolist()
+    assert int(st1.cpu_free.max()) > 0

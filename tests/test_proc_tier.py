@@ -160,3 +160,21 @@ def test_timerfd_pipe_poll_surface(clock_plugin):
     assert tier.exit_codes == {0: 0}, (tier.exit_codes, tier.logs)
     assert any("clock done: 5 ticks" in m for _, _, m in tier.logs)
     tier.close()
+
+
+def test_echo_pair_over_lossy_path(plugin):
+    """Real binaries over a lossy link: the in-order device TCP recovers
+    every byte, so the native endpoints still verify their payloads
+    (the reference's lossy tcp configs, src/test/tcp/CMakeLists.txt)."""
+    from shadow_tpu.proc import ProcessTier
+
+    lossy_topo = TOPO.replace(
+        '<data key="d4">0.0</data>', '<data key="d4">0.1</data>'
+    )
+    n = 20_000
+    cfg_text = echo_config(plugin, n).replace(TOPO, lossy_topo)
+    cfg = parse_config(cfg_text)
+    tier = ProcessTier(cfg, seed=11)
+    tier.run()
+    assert tier.exit_codes == {0: 0, 1: 0}, (tier.exit_codes, tier.logs)
+    tier.close()
