@@ -384,6 +384,7 @@ def build_simulation(
     mesh: Any = None,
     tcp_cc: str = "reno",
     tcp_in_order: bool = True,
+    tcp_wnd_words: int | None = None,
     rx_queue: str = "codel",
     qdisc: str = "fifo",
     interface_buffer: int = 1_024_000,
@@ -476,6 +477,7 @@ def build_simulation(
         n_hosts, n_sockets, jnp.asarray(bw_up), jnp.asarray(bw_down),
         with_tcp=model.needs_tcp,
         rcv_wnd_bytes=rcv_wnd_bytes if rcv_wnd_bytes.any() else None,
+        wnd_words=tcp_wnd_words,
         rx_buf_bytes=jnp.asarray(rx_buf),
     )
     if pcap_mask.any():
