@@ -9,7 +9,21 @@ against a single-threaded heapq discrete-event loop running the identical
 workload (the classic CPU DES architecture the reference's serial scheduler
 policy embodies — scheduler_policy_global_single.c).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line per completed stage, each a complete result superset
+of the previous, so the *last* line is always the richest result available
+when the process ends — even if an external budget kills it mid-stage:
+
+  1. primary PHOLD (batched drain) — the headline metric, printed the
+     moment it lands;
+  2. + skewed-target PHOLD;
+  3. + 1k-host Tor circuits (BASELINE config 3 shape);
+  4. + 1k-node Bitcoin gossip (BASELINE config 5 shape).
+
+Compilation is cached persistently in .jax_cache (measured on the axon
+TPU backend: a 101s cold compile re-loads in ~1s), so re-runs on the same
+machine skip straight to execution. A wall-clock budget (BENCH_BUDGET_S,
+default 840s) governs the secondary stages: each runs only if enough
+budget remains, so the primary number always survives.
 """
 
 import heapq
@@ -20,6 +34,9 @@ import random
 import sys
 import time
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache"))
+
 N_HOSTS = 4096
 MSGS_PER_HOST = 8
 CAPACITY = 64
@@ -27,6 +44,26 @@ STOP_SIM_SECONDS = 20
 SEED = 1234
 LATENCY_S = 0.050
 MEAN_DELAY_S = 0.010
+
+_T0 = time.monotonic()
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 840))
+
+
+def _remaining() -> float:
+    return _BUDGET_S - (time.monotonic() - _T0)
+
+
+def _enable_compile_cache():
+    """Persistent compilation cache: the dominant bench cost on a cold
+    machine is XLA compilation (~2-6 min per distinct program over the
+    axon tunnel); caching makes every later process/run pay ~1s instead."""
+    import jax
+
+    cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 def python_baseline_rate(
@@ -60,7 +97,9 @@ def python_baseline_rate(
     return sorted(rates)[len(rates) // 2]
 
 
-def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY):
+def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
+             batched=True):
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
 
@@ -76,6 +115,7 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY):
         seed=SEED,
         hot_hosts=hot_hosts,
         hot_weight=hot_weight,
+        batched=batched,
     )
     run = jax.jit(eng.run)
 
@@ -100,11 +140,14 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY):
         "drops": int(st.queues.drops.sum()),
         "device": str(dev.device_kind),
         "n_hosts": N_HOSTS,
+        "drain": "batched" if batched else "sequential",
     }
 
 
 def tor_worker():
-    """Secondary metric: Tor-circuit workload (BASELINE config 3 shape)."""
+    """Secondary metric: 1k-host Tor-circuit workload (BASELINE config 3:
+    '1k-node Tor network ... relays + clients')."""
+    _enable_compile_cache()
     import jax
 
     from shadow_tpu.config import parse_config
@@ -112,11 +155,13 @@ def tor_worker():
     from shadow_tpu.sim import build_simulation
 
     stop_s = 20
-    # sized to the largest socket-table width proven stable on the axon
-    # TPU backend (S>=96 currently faults the device at compile/run)
+    # 1020 hosts: 3x110 relays + 660 clients + 30 servers. Relay socket
+    # pressure is ~2 slots per circuit through it (inbound child +
+    # outbound), so ~6 circuits/guard on average keeps the table well
+    # under the S=48 width proven stable on the axon backend
     cfg = parse_config(tor_example(
-        n_relays_per_class=4, n_clients=60, n_servers=4,
-        filesize="128KiB", count=3, stoptime=stop_s,
+        n_relays_per_class=110, n_clients=660, n_servers=30,
+        filesize="64KiB", count=2, stoptime=stop_s,
     ))
     sim = build_simulation(cfg, seed=1, n_sockets=48, capacity=768)
     sim.strict_overflow = False
@@ -137,6 +182,7 @@ def tor_worker():
 
 def btc_worker():
     """Secondary metric: Bitcoin gossip (BASELINE config 5 shape)."""
+    _enable_compile_cache()
     import jax
 
     from shadow_tpu.config import parse_config
@@ -162,33 +208,35 @@ def btc_worker():
     }))
 
 
-def run_secondary(flag: str, timeout: int = 1500, retries: int = 1) -> dict:
+def run_secondary(flag: str, nominal_timeout: int = 600) -> dict:
     """Isolate workloads in a subprocess: a TPU fault, a compile blow-up,
-    or a hung accelerator tunnel must not cost the other metrics. One
-    retry by default — transient tunnel stalls are common enough that a
-    single re-attempt meaningfully improves bench reliability. Failures
-    surface the worker's stderr tail so real crashes keep a traceback."""
+    or a hung accelerator tunnel must not cost the already-printed
+    metrics. The subprocess reuses the persistent compilation cache, so a
+    warm machine pays seconds, not the cold compile. The timeout is the
+    smaller of the nominal value and the remaining bench budget; with
+    under a minute left the stage is skipped outright."""
     import subprocess
 
-    last_err = ""
-    for _ in range(1 + retries):
-        try:
-            res = subprocess.run(
-                [sys.executable, __file__, flag],
-                capture_output=True, text=True, timeout=timeout,
-            )
-            for line in reversed(res.stdout.strip().splitlines()):
-                try:
-                    return json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-            last_err = res.stderr
-        except subprocess.TimeoutExpired:
-            last_err = f"timed out after {timeout}s"
-            continue
-    if last_err:
+    timeout = min(nominal_timeout, _remaining() - 30)
+    if timeout < 60:
+        print(f"bench: skipping {flag} (budget exhausted)", file=sys.stderr)
+        return {}
+    try:
+        res = subprocess.run(
+            [sys.executable, __file__, flag],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        for line in reversed(res.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        err = res.stderr
+    except subprocess.TimeoutExpired:
+        err = f"timed out after {timeout:.0f}s"
+    if err:
         print(f"bench worker {flag} failed:\n"
-              + "\n".join(last_err.strip().splitlines()[-12:]),
+              + "\n".join(err.strip().splitlines()[-12:]),
               file=sys.stderr)
     return {}
 
@@ -209,37 +257,45 @@ def skew_worker():
 
 
 def main():
-    if "--tor-worker" in sys.argv:
-        tor_worker()
-        return
-    if "--btc-worker" in sys.argv:
-        btc_worker()
-        return
-    if "--phold-worker" in sys.argv:
-        phold_worker()
-        return
-    if "--skew-worker" in sys.argv:
-        skew_worker()
-        return
+    for flag, fn in (("--tor-worker", tor_worker),
+                     ("--btc-worker", btc_worker),
+                     ("--phold-worker", phold_worker),
+                     ("--skew-worker", skew_worker)):
+        if flag in sys.argv:
+            fn()
+            return
     stop_s = int(sys.argv[1]) if len(sys.argv) > 1 else STOP_SIM_SECONDS
     os.environ["BENCH_STOP_S"] = str(stop_s)
     py_rate = python_baseline_rate()
-    # budget scales with the requested horizon: compile (~5 min worst
-    # case over a cold tunnel) plus generous per-sim-second headroom
-    r = run_secondary("--phold-worker", timeout=max(1500, 60 * stop_s))
-    if not r:
-        # a dead/hung accelerator must still produce the JSON line
+
+    # sentinel line FIRST: if the in-process primary hangs on a stalled
+    # accelerator tunnel and an external budget kills us, the run still
+    # ends with one parseable JSON line explaining what happened
+    print(json.dumps({
+        "metric": "phold_events_per_sec", "value": 0.0,
+        "unit": "events/s", "vs_baseline": 0.0,
+        "error": "primary workload did not complete (hang or external kill)",
+        "baseline_python_events_per_sec": round(py_rate, 1),
+    }), flush=True)
+
+    # primary runs IN-PROCESS: no subprocess can be killed before the
+    # headline number prints. On the axon backend the parent holding the
+    # device does not stop the secondary subprocesses from attaching
+    # (verified: the skew/tor workers return results while the parent
+    # stays live); on an exclusive-access libtpu runtime the secondaries
+    # would degrade to {} — and the primary line still lands, which is
+    # the priority ordering this file exists to guarantee
+    try:
+        r = tpu_rate(stop_s)
+    except Exception as e:  # noqa: BLE001 — a dead accelerator must
+        # still produce the JSON line
         print(json.dumps({
             "metric": "phold_events_per_sec", "value": 0.0,
             "unit": "events/s", "vs_baseline": 0.0,
-            "error": "primary workload failed or timed out",
+            "error": f"primary workload failed: {type(e).__name__}: {e}",
             "baseline_python_events_per_sec": round(py_rate, 1),
-        }))
+        }), flush=True)
         return
-    rs = run_secondary("--skew-worker") or {
-        "skew_events_per_s": 0.0, "skew_sim_s_per_wall_s": 0.0,
-        "skew_drops": -1,
-    }
     out = {
         "metric": "phold_events_per_sec",
         "value": round(r["events_per_s"], 1),
@@ -252,16 +308,32 @@ def main():
         "wall_s": round(r["wall_s"], 3),
         "windows": r["windows"],
         "drops": r["drops"],
-        "skew_events_per_s": round(rs.get("skew_events_per_s", 0.0), 1),
-        "skew_sim_s_per_wall_s": round(
-            rs.get("skew_sim_s_per_wall_s", 0.0), 3
-        ),
-        "skew_drops": rs.get("skew_drops", -1),
+        "drain": r["drain"],
         "device": r["device"],
     }
-    out.update(run_secondary("--tor-worker"))
-    out.update(run_secondary("--btc-worker"))
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+
+    # secondaries enrich the result; every stage re-prints the full dict
+    # so the last line is always a complete superset. Tor first: the
+    # 1k-host sim-s/wall-s is the BASELINE config-3 headline
+    rt = run_secondary("--tor-worker")
+    if rt:
+        out.update(rt)
+        print(json.dumps(out), flush=True)
+    rb = run_secondary("--btc-worker")
+    if rb:
+        out.update(rb)
+        print(json.dumps(out), flush=True)
+    rs = run_secondary("--skew-worker")
+    if rs:
+        out.update({
+            "skew_events_per_s": round(rs.get("skew_events_per_s", 0.0), 1),
+            "skew_sim_s_per_wall_s": round(
+                rs.get("skew_sim_s_per_wall_s", 0.0), 3
+            ),
+            "skew_drops": rs.get("skew_drops", -1),
+        })
+        print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -19,3 +19,29 @@ if "--xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite's dominant cost is XLA
+# recompiling near-identical engine programs in every test process
+# (measured: a cold full-suite run spends >80% of its wall time in
+# compiles). Cache entries are keyed on HLO hash, so identical
+# (shape, handler-table) engines across tests and across runs share one
+# compile. Same mechanism bench.py uses on the TPU backend.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-mark tests so a <5-min smoke lane exists:
+    `pytest -m "not slow"` skips the heavyweight end-to-end runs."""
+    import pytest
+
+    slow_files = {
+        "test_tor_bitcoin.py", "test_multimodel.py", "test_tcp_matrix.py",
+        "test_proc_tier.py", "test_multichip.py", "test_interpose.py",
+    }
+    for item in items:
+        if item.fspath.basename in slow_files:
+            item.add_marker(pytest.mark.slow)
