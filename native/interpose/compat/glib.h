@@ -1,0 +1,48 @@
+/* Minimal glib.h stand-in for compiling reference test sources that
+ * include <glib.h> only for its assertion/logging macros (e.g.
+ * /root/reference/src/test/test_glib_helpers.h). The real GLib is not
+ * part of this framework; plugins built for the simulator need exactly
+ * g_error/g_test_fail-shaped failure reporting, nothing more. This is
+ * an original compatibility shim, not GLib code. */
+#ifndef SHADOW_TPU_COMPAT_GLIB_H
+#define SHADOW_TPU_COMPAT_GLIB_H
+
+#include <limits.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+/* real GLib's g_error is noreturn (aborts); assertion helpers rely on
+ * that, so a failed assertion must terminate the virtual process */
+#define g_error(...)                                                       \
+    do {                                                                   \
+        fprintf(stderr, "g_error: " __VA_ARGS__);                          \
+        fprintf(stderr, "\n");                                             \
+        exit(EXIT_FAILURE);                                                \
+    } while (0)
+
+#define g_warning(...)                                                     \
+    do {                                                                   \
+        fprintf(stderr, "g_warning: " __VA_ARGS__);                        \
+        fprintf(stderr, "\n");                                             \
+    } while (0)
+
+#define g_message(...)                                                     \
+    do {                                                                   \
+        fprintf(stdout, __VA_ARGS__);                                      \
+        fprintf(stdout, "\n");                                             \
+    } while (0)
+
+static inline void g_test_fail(void) {}
+
+#define g_assert(expr)                                                     \
+    do {                                                                   \
+        if (!(expr)) {                                                     \
+            fprintf(stderr, "assertion failed: %s\n", #expr);              \
+            exit(EXIT_FAILURE);                                            \
+        }                                                                  \
+    } while (0)
+
+#define g_assert_true(expr) g_assert(expr)
+
+#endif /* SHADOW_TPU_COMPAT_GLIB_H */

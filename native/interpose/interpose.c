@@ -1,0 +1,1074 @@
+/* interpose.c — libshadow_interpose.so: the libc surface for unmodified
+ * POSIX plugins.
+ *
+ * The TPU-era counterpart of the reference's preload library: where
+ * Shadow defines ~230 libc symbols in front of real binaries and routes
+ * them to process_emu_* on the active virtual process (reference:
+ * src/preload/preload_defs.h:10-375, src/preload/interposer.c:37-135,
+ * src/main/host/process.c), this library defines the core POSIX surface
+ * and routes it to the green-thread shim runtime's ShimAPI vtable
+ * (native/shim/shim_api.h).
+ *
+ * Linking model: a plugin is built from UNMODIFIED source (ordinary
+ * `main`, plain socket/poll/epoll/select calls) as a shared object with
+ * `-lshadow_interpose` ahead of libc. Inside the plugin's dlmopen
+ * namespace this library precedes libc in symbol search order, so the
+ * plugin's libc calls resolve here; anything not defined here falls
+ * through to the real libc of that namespace. The runtime installs its
+ * vtable per namespace via shadow_interpose_install() right after
+ * dlmopen (pointers cross namespaces; symbols do not — the reference
+ * crosses the same boundary through its loader's per-namespace state,
+ * src/external/elf-loader/README:25-33).
+ *
+ * fd model: plugins see small per-process VIRTUAL fds (VFD_BASE..1023,
+ * select()-compatible like the reference's MIN_DESCRIPTOR=10 table,
+ * definitions.h:88) mapped to runtime fds — the role of the reference's
+ * shadow<->OS descriptor maps (host.c:76-91). Unknown fds (stdio,
+ * passthrough files) fall through to real libc.
+ *
+ * Virtual time: clock_gettime/gettimeofday/time report simulated
+ * nanoseconds offset to the Y2K epoch, the reference's
+ * EMULATED_TIME_OFFSET contract (definitions.h:78, worker.c:385-390).
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <arpa/inet.h>
+#include <poll.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/msg.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/timerfd.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+#include "shim_api.h"
+
+/* Virtual fds start high enough that real OS fds of the simulator
+ * process (kernel allocates lowest-free) are unlikely to reach them,
+ * yet low enough for glibc's 1024-bit fd_set. */
+#define VFD_BASE 600
+#define VFD_MAX 4096
+
+/* sim ns -> unix epoch offset (Y2K), matching the reference
+ * (definitions.h:78 EMULATED_TIME_OFFSET) */
+#define EMULATED_EPOCH_NS 946684800000000000LL
+
+static const ShimAPI* A = 0;
+
+/* The runtime calls this right after dlmopen'ing a plugin whose
+ * namespace contains this library. */
+void shadow_interpose_install(const ShimAPI* api) { A = api; }
+
+/* ------------------------------------------------------- real fallbacks */
+
+#define REAL(ret, name, params)                                            \
+    static ret(*real_##name) params = 0;                                   \
+    static ret(*get_real_##name(void)) params {                            \
+        if (!real_##name) real_##name = dlsym(RTLD_NEXT, #name);           \
+        return real_##name;                                                \
+    }
+
+REAL(ssize_t, read, (int, void*, size_t))
+REAL(ssize_t, write, (int, const void*, size_t))
+REAL(int, close, (int))
+REAL(ssize_t, msgrcv, (int, void*, size_t, long, int))
+REAL(int, msgsnd, (int, const void*, size_t, int))
+REAL(int, fcntl, (int, int, ...))
+
+/* -------------------------------------------------- per-process vfds */
+
+typedef struct EpollWatch {
+    int vfd;
+    uint32_t events;
+    epoll_data_t data;
+} EpollWatch;
+
+typedef struct Vfd {
+    unsigned char used;
+    unsigned char nonblock;
+    unsigned char is_epoll;
+    unsigned char is_timer;
+    unsigned char connect_started;
+    int rfd; /* runtime fd; -1 for interposer-local (epoll) */
+    int n_watch, cap_watch;
+    EpollWatch* watch;
+} Vfd;
+
+typedef struct PerProc {
+    Vfd* tab; /* indexed vfd - VFD_BASE */
+    int len;
+    int next;
+} PerProc;
+
+static PerProc* g_pp = 0;
+static int g_npp = 0;
+
+static PerProc* pp(void) {
+    int pid = A ? A->current_pid(A->ctx) : -1;
+    if (pid < 0) return 0;
+    if (pid >= g_npp) {
+        int n = g_npp ? g_npp : 16;
+        while (n <= pid) n *= 2;
+        PerProc* t = realloc(g_pp, n * sizeof(PerProc));
+        if (!t) return 0;
+        memset(t + g_npp, 0, (n - g_npp) * sizeof(PerProc));
+        g_pp = t;
+        g_npp = n;
+    }
+    return &g_pp[pid];
+}
+
+static Vfd* vfd_get(int vfd) {
+    PerProc* p = pp();
+    if (!p || vfd < VFD_BASE) return 0;
+    int idx = vfd - VFD_BASE;
+    if (idx >= p->len) return 0;
+    Vfd* v = &p->tab[idx];
+    return v->used ? v : 0;
+}
+
+static int vfd_alloc(int rfd) {
+    PerProc* p = pp();
+    if (!p) return -1;
+    int idx = p->next;
+    /* skip numbers that are live REAL fds of the simulator process (a
+     * JAX host can hold many device/cache fds): handing such a number
+     * out would make read/write/close on the real fd misroute into the
+     * simulated stack. Kernel fds allocate lowest-free, so once past
+     * the process's high-water mark this loop exits immediately. */
+    while (VFD_BASE + idx < VFD_MAX &&
+           get_real_fcntl()(VFD_BASE + idx, F_GETFD, 0) != -1) {
+        idx++;
+        p->next = idx;
+    }
+    if (VFD_BASE + idx >= VFD_MAX) {
+        /* scan for a freed slot before giving up */
+        for (idx = 0; idx < p->len && p->tab[idx].used; idx++) {
+        }
+        if (VFD_BASE + idx >= VFD_MAX) return -1;
+    }
+    if (idx >= p->len) {
+        int n = p->len ? p->len : 32;
+        while (n <= idx) n *= 2;
+        Vfd* t = realloc(p->tab, n * sizeof(Vfd));
+        if (!t) return -1;
+        memset(t + p->len, 0, (n - p->len) * sizeof(Vfd));
+        p->tab = t;
+        p->len = n;
+    }
+    memset(&p->tab[idx], 0, sizeof(Vfd));
+    p->tab[idx].used = 1;
+    p->tab[idx].rfd = rfd;
+    if (idx == p->next) p->next++;
+    return VFD_BASE + idx;
+}
+
+static void vfd_free(int vfd) {
+    Vfd* v = vfd_get(vfd);
+    if (!v) return;
+    free(v->watch);
+    memset(v, 0, sizeof(*v));
+}
+
+/* ----------------------------------------------------------- sockets */
+
+int socket(int domain, int type, int protocol) {
+    (void)protocol;
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    if (domain != AF_INET || (type & 0xFF) != SOCK_STREAM) {
+        /* the simulated stack is TCP/IPv4 for interposed plugins; the
+         * reference likewise forwards only what its host model
+         * implements (host.c:773-860) */
+        errno = EAFNOSUPPORT;
+        return -1;
+    }
+    int rfd = A->sock_socket(A->ctx);
+    if (rfd < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    int vfd = vfd_alloc(rfd);
+    if (vfd < 0) {
+        A->sock_close(A->ctx, rfd);
+        errno = EMFILE;
+        return -1;
+    }
+    Vfd* v = vfd_get(vfd);
+    v->nonblock = (type & SOCK_NONBLOCK) ? 1 : 0;
+    return vfd;
+}
+
+int bind(int fd, const struct sockaddr* addr, socklen_t len) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    int port = 0;
+    if (addr && len >= sizeof(struct sockaddr_in) &&
+        addr->sa_family == AF_INET) {
+        port = ntohs(((const struct sockaddr_in*)addr)->sin_port);
+    }
+    if (A->sock_bind(A->ctx, v->rfd, port) < 0) {
+        errno = EBADF;
+        return -1;
+    }
+    return 0;
+}
+
+int listen(int fd, int backlog) {
+    (void)backlog;
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    /* port 0 -> the port recorded by bind (ephemeral when unbound) */
+    if (A->sock_listen(A->ctx, v->rfd, 0) < 0) {
+        errno = EBADF;
+        return -1;
+    }
+    return 0;
+}
+
+static void fill_inet_addr(struct sockaddr* addr, socklen_t* addrlen,
+                           uint32_t ip, int port) {
+    if (!addr || !addrlen) return;
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof(a));
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(ip);
+    a.sin_port = htons((uint16_t)port);
+    socklen_t n = *addrlen < sizeof(a) ? *addrlen : (socklen_t)sizeof(a);
+    memcpy(addr, &a, n);
+    *addrlen = sizeof(a);
+}
+
+int accept4(int fd, struct sockaddr* addr, socklen_t* addrlen, int flags) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    int child_rfd;
+    if (v->nonblock) {
+        child_rfd = A->try_accept(A->ctx, v->rfd);
+        if (child_rfd < 0) {
+            errno = EAGAIN;
+            return -1;
+        }
+    } else {
+        child_rfd = A->sock_accept(A->ctx, v->rfd);
+        if (child_rfd < 0) {
+            errno = EINVAL;
+            return -1;
+        }
+    }
+    int cvfd = vfd_alloc(child_rfd);
+    if (cvfd < 0) {
+        /* don't orphan the established runtime connection */
+        A->sock_close(A->ctx, child_rfd);
+        errno = EMFILE;
+        return -1;
+    }
+    vfd_get(cvfd)->nonblock = (flags & SOCK_NONBLOCK) ? 1 : 0;
+    fill_inet_addr(addr, addrlen, 0, 0);
+    return cvfd;
+}
+
+int accept(int fd, struct sockaddr* addr, socklen_t* addrlen) {
+    return accept4(fd, addr, addrlen, 0);
+}
+
+int connect(int fd, const struct sockaddr* addr, socklen_t len) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    if (!addr || len < sizeof(struct sockaddr_in) ||
+        addr->sa_family != AF_INET) {
+        errno = EINVAL;
+        return -1;
+    }
+    if (v->connect_started) {
+        /* repeat connect() after EINPROGRESS: 0 once established (the
+         * loop idiom the reference's own tests use, test_tcp.c
+         * _do_connect — its emulated connect behaves this way too) */
+        int st = A->conn_status(A->ctx, v->rfd);
+        if (st == 1) return 0;
+        errno = (st == -1) ? ECONNREFUSED : EALREADY;
+        return -1;
+    }
+    const struct sockaddr_in* sin = (const struct sockaddr_in*)addr;
+    uint32_t ip = ntohl(sin->sin_addr.s_addr);
+    int port = ntohs(sin->sin_port);
+    v->connect_started = 1;
+    int rv = A->sock_connect_ip(A->ctx, v->rfd, ip, port, v->nonblock);
+    if (v->nonblock) {
+        errno = EINPROGRESS;
+        return -1;
+    }
+    if (rv < 0) {
+        errno = ECONNREFUSED;
+        return -1;
+    }
+    return 0;
+}
+
+ssize_t send(int fd, const void* buf, size_t n, int flags) {
+    (void)flags;
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    int64_t rv = A->sock_send(A->ctx, v->rfd, buf, (int64_t)n);
+    if (rv < 0) {
+        errno = EPIPE;
+        return -1;
+    }
+    return (ssize_t)rv;
+}
+
+ssize_t sendto(int fd, const void* buf, size_t n, int flags,
+               const struct sockaddr* addr, socklen_t alen) {
+    (void)addr;
+    (void)alen;
+    return send(fd, buf, n, flags);
+}
+
+ssize_t recv(int fd, void* buf, size_t cap, int flags) {
+    (void)flags;
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    if (v->nonblock) {
+        if (A->readable_n(A->ctx, v->rfd) <= 0 &&
+            !A->at_eof(A->ctx, v->rfd)) {
+            errno = EAGAIN;
+            return -1;
+        }
+    }
+    int64_t rv = A->sock_recv(A->ctx, v->rfd, buf, (int64_t)cap);
+    if (rv < 0) {
+        errno = EBADF;
+        return -1;
+    }
+    return (ssize_t)rv;
+}
+
+ssize_t recvfrom(int fd, void* buf, size_t cap, int flags,
+                 struct sockaddr* addr, socklen_t* alen) {
+    fill_inet_addr(addr, alen, 0, 0);
+    return recv(fd, buf, cap, flags);
+}
+
+ssize_t read(int fd, void* buf, size_t cap) {
+    Vfd* v = vfd_get(fd);
+    if (!v) return get_real_read()(fd, buf, cap);
+    if (v->is_timer) {
+        /* timerfd read: u64 expiration count (timer.c:23-42) */
+        if (cap < 8) {
+            errno = EINVAL;
+            return -1;
+        }
+        if (v->nonblock) {
+            unsigned char want = 1;
+            int rfd = v->rfd;
+            if (!A->poll2(A->ctx, &rfd, &want, 1, 0)) {
+                errno = EAGAIN;
+                return -1;
+            }
+        }
+        int64_t n = A->timer_read(A->ctx, v->rfd);
+        if (n < 0) {
+            errno = EBADF;
+            return -1;
+        }
+        memcpy(buf, &n, 8);
+        return 8;
+    }
+    return recv(fd, buf, cap, 0);
+}
+
+ssize_t write(int fd, const void* buf, size_t n) {
+    Vfd* v = vfd_get(fd);
+    if (!v) return get_real_write()(fd, buf, n);
+    return send(fd, buf, n, 0);
+}
+
+ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    /* one recv's worth of bytes scattered across the iov — readv's
+     * single-message semantics over a stream */
+    size_t total = 0;
+    for (int i = 0; i < iovcnt; i++) total += iov[i].iov_len;
+    if (total == 0) return 0;
+    char* tmp = malloc(total);
+    if (!tmp) {
+        errno = ENOMEM;
+        return -1;
+    }
+    ssize_t got = recv(fd, tmp, total, 0);
+    if (got <= 0) {
+        free(tmp);
+        return got;
+    }
+    size_t off = 0;
+    for (int i = 0; i < iovcnt && off < (size_t)got; i++) {
+        size_t take = iov[i].iov_len;
+        if (take > (size_t)got - off) take = (size_t)got - off;
+        memcpy(iov[i].iov_base, tmp + off, take);
+        off += take;
+    }
+    free(tmp);
+    return got;
+}
+
+ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    ssize_t total = 0;
+    for (int i = 0; i < iovcnt; i++) {
+        if (iov[i].iov_len == 0) continue;
+        ssize_t rv = send(fd, iov[i].iov_base, iov[i].iov_len, 0);
+        if (rv < 0) return total > 0 ? total : rv;
+        total += rv;
+    }
+    return total;
+}
+
+static void epoll_forget(int vfd) {
+    /* Linux auto-removes a closed fd from every epoll interest set; a
+     * stale watch here would read as permanently ready and spin the
+     * green thread. Scan this process's epoll instances. */
+    PerProc* p = pp();
+    if (!p) return;
+    for (int i = 0; i < p->len; i++) {
+        Vfd* e = &p->tab[i];
+        if (!e->used || !e->is_epoll) continue;
+        for (int j = 0; j < e->n_watch; j++) {
+            if (e->watch[j].vfd == vfd) {
+                e->watch[j] = e->watch[--e->n_watch];
+                break;
+            }
+        }
+    }
+}
+
+int close(int fd) {
+    Vfd* v = vfd_get(fd);
+    if (!v) return get_real_close()(fd);
+    int rfd = v->rfd;
+    int local = v->is_epoll;
+    epoll_forget(fd);
+    vfd_free(fd);
+    if (local) return 0; /* epoll instances are interposer-local */
+    return A->sock_close(A->ctx, rfd);
+}
+
+int shutdown(int fd, int how) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    if (how == SHUT_WR || how == SHUT_RDWR) {
+        /* FIN the write side; reads continue until EOF (the runtime
+         * keeps the in-stream alive after close, tcp.c semantics) */
+        return A->sock_close(A->ctx, v->rfd);
+    }
+    return 0;
+}
+
+int getsockname(int fd, struct sockaddr* addr, socklen_t* addrlen) {
+    Vfd* v = vfd_get(fd);
+    if (!v || !addr || !addrlen) {
+        errno = EBADF;
+        return -1;
+    }
+    fill_inet_addr(addr, addrlen, 0,
+                   A->sock_local_port(A->ctx, v->rfd));
+    return 0;
+}
+
+int getpeername(int fd, struct sockaddr* addr, socklen_t* addrlen) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    fill_inet_addr(addr, addrlen, 0, 0);
+    return 0;
+}
+
+int setsockopt(int fd, int level, int optname, const void* optval,
+               socklen_t optlen) {
+    (void)level;
+    (void)optname;
+    (void)optval;
+    (void)optlen;
+    if (!vfd_get(fd)) {
+        errno = EBADF;
+        return -1;
+    }
+    /* accepted and ignored: buffer/Nagle knobs are modeled by the device
+     * TCP (autotuned windows, the tcp.c:407-598 analog) */
+    return 0;
+}
+
+int getsockopt(int fd, int level, int optname, void* optval,
+               socklen_t* optlen) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
+        errno = EBADF;
+        return -1;
+    }
+    if (level == SOL_SOCKET && optname == SO_ERROR && optval && optlen &&
+        *optlen >= sizeof(int)) {
+        int st = A->conn_status(A->ctx, v->rfd);
+        *(int*)optval = (st == -1) ? ECONNREFUSED : 0;
+        *optlen = sizeof(int);
+        return 0;
+    }
+    if (optval && optlen && *optlen >= sizeof(int)) {
+        *(int*)optval = 0;
+        *optlen = sizeof(int);
+    }
+    return 0;
+}
+
+int fcntl(int fd, int cmd, ...) {
+    va_list ap;
+    va_start(ap, cmd);
+    long arg = va_arg(ap, long);
+    va_end(ap);
+    Vfd* v = vfd_get(fd);
+    if (!v) return get_real_fcntl()(fd, cmd, arg);
+    if (cmd == F_GETFL) return v->nonblock ? O_NONBLOCK : 0;
+    if (cmd == F_SETFL) {
+        v->nonblock = (arg & O_NONBLOCK) ? 1 : 0;
+        return 0;
+    }
+    return 0;
+}
+
+/* --------------------------------------------------------------- pipes */
+
+int pipe2(int fds[2], int flags) {
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    int r, w;
+    if (A->pipe2(A->ctx, &r, &w) < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    int rv = vfd_alloc(r), wv = vfd_alloc(w);
+    if (rv < 0 || wv < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    if (flags & O_NONBLOCK) {
+        vfd_get(rv)->nonblock = 1;
+        vfd_get(wv)->nonblock = 1;
+    }
+    fds[0] = rv;
+    fds[1] = wv;
+    return 0;
+}
+
+int pipe(int fds[2]) { return pipe2(fds, 0); }
+
+/* ------------------------------------------------------------- timerfd */
+
+int timerfd_create(int clockid, int flags) {
+    (void)clockid;
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    int rfd = A->timer_create(A->ctx);
+    if (rfd < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    int vfd = vfd_alloc(rfd);
+    if (vfd < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    Vfd* v = vfd_get(vfd);
+    v->is_timer = 1;
+    v->nonblock = (flags & TFD_NONBLOCK) ? 1 : 0;
+    return vfd;
+}
+
+int timerfd_settime(int fd, int flags, const struct itimerspec* new_value,
+                    struct itimerspec* old_value) {
+    (void)flags;
+    (void)old_value;
+    Vfd* v = vfd_get(fd);
+    if (!v || !new_value) {
+        errno = EBADF;
+        return -1;
+    }
+    int64_t first = (int64_t)new_value->it_value.tv_sec * 1000000000LL +
+                    new_value->it_value.tv_nsec;
+    int64_t interval =
+        (int64_t)new_value->it_interval.tv_sec * 1000000000LL +
+        new_value->it_interval.tv_nsec;
+    if (A->timer_settime(A->ctx, v->rfd, first, interval) < 0) {
+        errno = EBADF;
+        return -1;
+    }
+    return 0;
+}
+
+/* ---------------------------------------------------------------- time */
+
+static int64_t emu_now_ns(void) {
+    return A ? A->time_ns(A->ctx) + EMULATED_EPOCH_NS : 0;
+}
+
+int gettimeofday(struct timeval* tv, void* tz) {
+    (void)tz;
+    if (!tv) return 0;
+    int64_t ns = emu_now_ns();
+    tv->tv_sec = ns / 1000000000LL;
+    tv->tv_usec = (ns % 1000000000LL) / 1000;
+    return 0;
+}
+
+int clock_gettime(clockid_t clk, struct timespec* ts) {
+    if (!ts) return 0;
+    int64_t ns = (clk == CLOCK_MONOTONIC || clk == CLOCK_MONOTONIC_RAW)
+                     ? (A ? A->time_ns(A->ctx) : 0)
+                     : emu_now_ns();
+    ts->tv_sec = ns / 1000000000LL;
+    ts->tv_nsec = ns % 1000000000LL;
+    return 0;
+}
+
+time_t time(time_t* t) {
+    time_t s = (time_t)(emu_now_ns() / 1000000000LL);
+    if (t) *t = s;
+    return s;
+}
+
+int nanosleep(const struct timespec* req, struct timespec* rem) {
+    if (!req) {
+        errno = EINVAL;
+        return -1;
+    }
+    if (rem) {
+        rem->tv_sec = 0;
+        rem->tv_nsec = 0;
+    }
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    A->sleep_ns(A->ctx, (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec);
+    return 0;
+}
+
+int usleep(useconds_t us) {
+    if (A) A->sleep_ns(A->ctx, (int64_t)us * 1000LL);
+    return 0;
+}
+
+unsigned int sleep(unsigned int s) {
+    if (A) A->sleep_ns(A->ctx, (int64_t)s * 1000000000LL);
+    return 0;
+}
+
+/* ----------------------------------------------------------------- DNS */
+
+int getaddrinfo(const char* node, const char* service,
+                const struct addrinfo* hints, struct addrinfo** res) {
+    if (!node || !res) return EAI_NONAME;
+    uint32_t ip = 0;
+    struct in_addr parsed;
+    if (A) ip = A->resolve(A->ctx, node);
+    if (!ip && inet_aton(node, &parsed)) ip = ntohl(parsed.s_addr);
+    if (!ip) return EAI_NONAME;
+
+    struct addrinfo* ai = calloc(1, sizeof(*ai));
+    struct sockaddr_in* sa = calloc(1, sizeof(*sa));
+    if (!ai || !sa) {
+        free(ai);
+        free(sa);
+        return EAI_MEMORY;
+    }
+    sa->sin_family = AF_INET;
+    sa->sin_addr.s_addr = htonl(ip);
+    sa->sin_port = htons(service ? (uint16_t)atoi(service) : 0);
+    ai->ai_family = AF_INET;
+    ai->ai_socktype = hints && hints->ai_socktype ? hints->ai_socktype
+                                                  : SOCK_STREAM;
+    ai->ai_protocol = IPPROTO_TCP;
+    ai->ai_addrlen = sizeof(*sa);
+    ai->ai_addr = (struct sockaddr*)sa;
+    *res = ai;
+    return 0;
+}
+
+void freeaddrinfo(struct addrinfo* res) {
+    while (res) {
+        struct addrinfo* next = res->ai_next;
+        free(res->ai_addr);
+        free(res);
+        res = next;
+    }
+}
+
+/* ---------------------------------------------------------- poll family */
+
+static int64_t ms_to_ns(int timeout_ms) {
+    return timeout_ms < 0 ? -1 : (int64_t)timeout_ms * 1000000LL;
+}
+
+/* zero-timeout single-fd readiness probe (read interest) */
+static int probe_read(int rfd) {
+    unsigned char want = 1, ready = 0;
+    return A->poll_many(A->ctx, &rfd, &want, 1, 0, &ready) > 0;
+}
+
+int poll(struct pollfd* fds, nfds_t nfds, int timeout_ms) {
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    if (nfds == 0) {
+        if (timeout_ms != 0) A->sleep_ns(A->ctx, ms_to_ns(timeout_ms));
+        return 0;
+    }
+    int stack_r[64];
+    unsigned char stack_w[64], stack_o[64];
+    int* rfds = nfds <= 64 ? stack_r : malloc(nfds * sizeof(int));
+    unsigned char* want = nfds <= 64 ? stack_w : malloc(nfds);
+    unsigned char* ready = nfds <= 64 ? stack_o : malloc(nfds);
+    if (!rfds || !want || !ready) {
+        errno = ENOMEM;
+        return -1;
+    }
+    int rc = -1;
+    for (nfds_t i = 0; i < nfds; i++) {
+        Vfd* v = vfd_get(fds[i].fd);
+        fds[i].revents = 0;
+        if (!v) {
+            errno = EBADF;
+            goto out;
+        }
+        rfds[i] = v->rfd;
+        want[i] = ((fds[i].events & POLLIN) ? 1 : 0) |
+                  ((fds[i].events & POLLOUT) ? 2 : 0);
+    }
+    {
+        int n = A->poll_many(A->ctx, rfds, want, (int)nfds, ms_to_ns(timeout_ms),
+                             ready);
+        rc = 0;
+        if (n <= 0) goto out;
+        for (nfds_t i = 0; i < nfds; i++) {
+            if (!ready[i]) continue;
+            short rev = 0;
+            if ((fds[i].events & POLLIN) && probe_read(rfds[i]))
+                rev |= POLLIN;
+            if ((fds[i].events & POLLOUT) && A->writable(A->ctx, rfds[i]))
+                rev |= POLLOUT;
+            if (A->conn_status(A->ctx, rfds[i]) == -1)
+                rev |= POLLERR | (short)(fds[i].events & POLLOUT);
+            if (!rev) continue;
+            fds[i].revents = rev;
+            rc++;
+        }
+    }
+out:
+    if (nfds > 64) {
+        free(rfds);
+        free(want);
+        free(ready);
+    }
+    return rc;
+}
+
+int select(int nfds, fd_set* readfds, fd_set* writefds, fd_set* exceptfds,
+           struct timeval* timeout) {
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    if (nfds < 0 || nfds > FD_SETSIZE) {
+        errno = EINVAL;
+        return -1;
+    }
+    int vlist[FD_SETSIZE], rfds[FD_SETSIZE];
+    unsigned char want[FD_SETSIZE], ready[FD_SETSIZE];
+    int n = 0;
+    for (int fd = 0; fd < nfds; fd++) {
+        unsigned char w = 0;
+        if (readfds && FD_ISSET(fd, readfds)) w |= 1;
+        if (writefds && FD_ISSET(fd, writefds)) w |= 2;
+        if (exceptfds && FD_ISSET(fd, exceptfds)) w |= 2;
+        if (!w) continue;
+        Vfd* v = vfd_get(fd);
+        if (!v) {
+            errno = EBADF;
+            return -1;
+        }
+        vlist[n] = fd;
+        rfds[n] = v->rfd;
+        want[n] = w;
+        n++;
+    }
+    int64_t tns = -1;
+    if (timeout)
+        tns = (int64_t)timeout->tv_sec * 1000000000LL +
+              (int64_t)timeout->tv_usec * 1000LL;
+    if (n == 0) {
+        if (tns > 0) A->sleep_ns(A->ctx, tns); /* pure sleep */
+        return 0;
+    }
+    int got = A->poll_many(A->ctx, rfds, want, n, tns, ready);
+    if (readfds) FD_ZERO(readfds);
+    if (writefds) FD_ZERO(writefds);
+    if (exceptfds) FD_ZERO(exceptfds);
+    if (got <= 0) return 0;
+    int count = 0;
+    for (int i = 0; i < n; i++) {
+        if (!ready[i]) continue;
+        int hit = 0;
+        if ((want[i] & 1) && readfds && probe_read(rfds[i])) {
+            FD_SET(vlist[i], readfds);
+            hit = 1;
+        }
+        if ((want[i] & 2) && writefds &&
+            (A->writable(A->ctx, rfds[i]) ||
+             A->conn_status(A->ctx, rfds[i]) == -1)) {
+            FD_SET(vlist[i], writefds);
+            hit = 1;
+        }
+        count += hit;
+    }
+    return count;
+}
+
+/* ---------------------------------------------------------------- epoll */
+
+int epoll_create1(int flags) {
+    (void)flags;
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    int vfd = vfd_alloc(-1);
+    if (vfd < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    vfd_get(vfd)->is_epoll = 1;
+    return vfd;
+}
+
+int epoll_create(int size) {
+    (void)size;
+    return epoll_create1(0);
+}
+
+int epoll_ctl(int epfd, int op, int fd, struct epoll_event* event) {
+    Vfd* e = vfd_get(epfd);
+    if (!e || !e->is_epoll) {
+        errno = EBADF;
+        return -1;
+    }
+    if (op == EPOLL_CTL_DEL) {
+        for (int i = 0; i < e->n_watch; i++) {
+            if (e->watch[i].vfd == fd) {
+                e->watch[i] = e->watch[--e->n_watch];
+                return 0;
+            }
+        }
+        errno = ENOENT;
+        return -1;
+    }
+    if (!event) {
+        errno = EFAULT;
+        return -1;
+    }
+    if (!vfd_get(fd)) {
+        errno = EBADF;
+        return -1;
+    }
+    for (int i = 0; i < e->n_watch; i++) {
+        if (e->watch[i].vfd == fd) {
+            if (op == EPOLL_CTL_ADD) {
+                errno = EEXIST;
+                return -1;
+            }
+            e->watch[i].events = event->events;
+            e->watch[i].data = event->data;
+            return 0;
+        }
+    }
+    if (op == EPOLL_CTL_MOD) {
+        errno = ENOENT;
+        return -1;
+    }
+    if (e->n_watch == e->cap_watch) {
+        int cap = e->cap_watch ? e->cap_watch * 2 : 8;
+        EpollWatch* w = realloc(e->watch, cap * sizeof(EpollWatch));
+        if (!w) {
+            errno = ENOMEM;
+            return -1;
+        }
+        e->watch = w;
+        e->cap_watch = cap;
+    }
+    e->watch[e->n_watch].vfd = fd;
+    e->watch[e->n_watch].events = event->events;
+    e->watch[e->n_watch].data = event->data;
+    e->n_watch++;
+    return 0;
+}
+
+int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
+               int timeout_ms) {
+    Vfd* e = vfd_get(epfd);
+    if (!e || !e->is_epoll) {
+        errno = EBADF;
+        return -1;
+    }
+    /* drop watches whose fd was closed without EPOLL_CTL_DEL (Linux
+     * auto-removes them; epoll_forget handles same-process closes and
+     * this sweep catches anything else) */
+    for (int i = 0; i < e->n_watch;) {
+        if (!vfd_get(e->watch[i].vfd)) {
+            e->watch[i] = e->watch[--e->n_watch];
+        } else {
+            i++;
+        }
+    }
+    if (e->n_watch == 0) {
+        if (timeout_ms != 0)
+            A->sleep_ns(A->ctx,
+                        ms_to_ns(timeout_ms < 0 ? 3600000 : timeout_ms));
+        return 0;
+    }
+    int n = e->n_watch;
+    int stack_r[64];
+    unsigned char stack_w[64], stack_o[64];
+    int* rfds = n <= 64 ? stack_r : malloc(n * sizeof(int));
+    unsigned char* want = n <= 64 ? stack_w : malloc(n);
+    unsigned char* ready = n <= 64 ? stack_o : malloc(n);
+    if (!rfds || !want || !ready) {
+        errno = ENOMEM;
+        return -1;
+    }
+    for (int i = 0; i < n; i++) {
+        rfds[i] = vfd_get(e->watch[i].vfd)->rfd;
+        want[i] = ((e->watch[i].events & EPOLLIN) ? 1 : 0) |
+                  ((e->watch[i].events & EPOLLOUT) ? 2 : 0);
+    }
+    int got = A->poll_many(A->ctx, rfds, want, n, ms_to_ns(timeout_ms),
+                           ready);
+    int count = 0;
+    for (int i = 0; i < n && count < maxevents && got > 0; i++) {
+        if (!ready[i]) continue;
+        uint32_t ev = 0;
+        if ((e->watch[i].events & EPOLLIN) && probe_read(rfds[i]))
+            ev |= EPOLLIN;
+        if ((e->watch[i].events & EPOLLOUT) && A->writable(A->ctx, rfds[i]))
+            ev |= EPOLLOUT;
+        if (A->conn_status(A->ctx, rfds[i]) == -1) ev |= EPOLLERR;
+        if (!ev) continue;
+        events[count].events = ev;
+        events[count].data = e->watch[i].data;
+        count++;
+    }
+    if (n > 64) {
+        free(rfds);
+        free(want);
+        free(ready);
+    }
+    return count;
+}
+
+/* ------------------------------------------------------ SysV msg queues */
+
+/* msgget/msgctl pass through (a real kernel queue inside the simulator
+ * process is a fine rendezvous between green threads), but a BLOCKING
+ * receive/send must not block the OS thread — every virtual process
+ * shares it. Poll with IPC_NOWAIT and yield simulated time between
+ * attempts (the green-thread analog of pth's nonblocking syscall
+ * re-entry, pth_high.c). */
+
+ssize_t msgrcv(int msqid, void* msgp, size_t msgsz, long msgtyp,
+               int msgflg) {
+    for (;;) {
+        ssize_t rv = get_real_msgrcv()(msqid, msgp, msgsz, msgtyp,
+                                       msgflg | IPC_NOWAIT);
+        if (rv >= 0 || errno != ENOMSG || (msgflg & IPC_NOWAIT)) return rv;
+        if (!A) return rv;
+        A->sleep_ns(A->ctx, 1000000); /* 1ms of simulated patience */
+    }
+}
+
+int msgsnd(int msqid, const void* msgp, size_t msgsz, int msgflg) {
+    for (;;) {
+        int rv = get_real_msgsnd()(msqid, msgp, msgsz, msgflg | IPC_NOWAIT);
+        if (rv >= 0 || errno != EAGAIN || (msgflg & IPC_NOWAIT)) return rv;
+        if (!A) return rv;
+        A->sleep_ns(A->ctx, 1000000);
+    }
+}
+
+/* ---------------------------------------------------------- environment */
+
+char* getenv(const char* name) {
+    /* a dlmopen'd secondary libc never ran __libc_start_main, so its
+     * environ is empty; resolve via the runtime's base namespace */
+    if (A) return (char*)A->env_get(A->ctx, name);
+    return 0;
+}
+
+/* -------------------------------------------------------------- process */
+
+void exit(int code) {
+    if (A) {
+        fflush(0);
+        A->proc_exit(A->ctx, code); /* never returns */
+    }
+    _Exit(code);
+}
+
+void _exit(int code) { exit(code); }
+
+void abort(void) { exit(134); }

@@ -60,6 +60,77 @@ typedef struct ShimAPI {
      * expired), 0 on timeout; timeout_ns < 0 waits forever */
     int (*poll_fds)(void* ctx, const int* fds, int nfds,
                     int64_t timeout_ns);                 /* blocks */
+
+    /* ---- v2: the POSIX-interposition surface (appended for ABI
+     * compatibility with v1 plugins). These power the libc interposer
+     * (native/interpose/interpose.c), the TPU-era counterpart of the
+     * reference's ~230 preloaded symbols backed by process_emu_*
+     * (reference: src/preload/preload_defs.h:10-375,
+     * src/main/host/process.c) — unmodified POSIX sources link against
+     * the interposer and never see this vtable directly. ---- */
+
+    /* record a local port on a socket before listen (bind semantics,
+     * host.c:773-860); port 0 allocates an ephemeral one; returns the
+     * bound port or -1 */
+    int (*sock_bind)(void* ctx, int fd, int port);
+
+    /* connect by virtual IPv4 (host byte order). nonblock=0 blocks until
+     * the handshake resolves (0 ok / -1 refused); nonblock=1 returns 0
+     * immediately — track progress via conn_status */
+    int (*sock_connect_ip)(void* ctx, int fd, uint32_t ip, int port,
+                           int nonblock);
+
+    /* name -> virtual IPv4 from the runtime's DNS table (dns.c registry
+     * pushed in by the driver); 0 = unknown host */
+    uint32_t (*resolve)(void* ctx, const char* name);
+
+    /* non-blocking accept: child fd, or -1 when the queue is empty */
+    int (*try_accept)(void* ctx, int fd);
+
+    /* 0 = handshake in progress, 1 = established, -1 = refused/closed */
+    int (*conn_status)(void* ctx, int fd);
+
+    /* readiness probes (nonblocking fast paths) */
+    int64_t (*readable_n)(void* ctx, int fd);  /* buffered in-bytes */
+    int (*at_eof)(void* ctx, int fd);          /* peer FIN, buffer drained */
+    int (*writable)(void* ctx, int fd);        /* established, not closed */
+
+    /* poll with per-fd interest: want[i] bit0 = read, bit1 = write.
+     * Returns bitmask over indices (bit i = fds[i] ready for something
+     * it wanted), 0 on timeout; timeout_ns < 0 waits forever */
+    int (*poll2)(void* ctx, const int* fds, const unsigned char* want,
+                 int nfds, int64_t timeout_ns);          /* blocks */
+
+    /* allocate a plain descriptor slot with no backing object (epoll
+     * instances and other interposer-side fds need real numbers) */
+    int (*fd_new)(void* ctx);
+
+    /* terminate the virtual process (exit() interposition); never
+     * returns — control jumps back to the scheduler */
+    void (*proc_exit)(void* ctx, int code);
+
+    /* bound local port of a listener/bound socket (getsockname), or 0 */
+    int (*sock_local_port)(void* ctx, int fd);
+
+    /* pid of the virtual process currently running on the green-thread
+     * scheduler (worker_setActiveProcess analog, worker.c) — the
+     * interposer namespaces its per-process fd tables with it */
+    int (*current_pid)(void* ctx);
+
+    /* getenv through the base namespace: a dlmopen'd secondary libc has
+     * no initialized environ, so interposed plugins resolve environment
+     * variables via the runtime (the reference re-execs itself with a
+     * curated environment instead, main.c:645-675) */
+    const char* (*env_get)(void* ctx, const char* name);
+
+    /* poll over arbitrarily many fds (epoll/poll with hundreds of
+     * connections — the reference's epoll table has no width limit,
+     * epoll.c): want[i] bit0 = read, bit1 = write; on return
+     * ready_out[i] != 0 marks readiness. Returns the ready count, 0 on
+     * timeout; timeout_ns < 0 waits forever. Blocks. */
+    int (*poll_many)(void* ctx, const int* fds, const unsigned char* want,
+                     int nfds, int64_t timeout_ns,
+                     unsigned char* ready_out);
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

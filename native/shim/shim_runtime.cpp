@@ -41,7 +41,16 @@
 namespace {
 
 constexpr size_t kStackSize = 512 * 1024;
-constexpr int kFirstFd = 3;
+/* Shim fds live far above any real OS fd so the libc interposer can
+ * route by range (the reference keeps shadow<->OS fd maps instead,
+ * host.c:76-91). Numbering is runtime-global: values stay unique
+ * across virtual processes, so per-fd interposer state can never
+ * collide even when namespaces share one interposer copy. The
+ * driver assigns accepted-child fds from 2'000'000 up (per-process
+ * counters — uniqueness there is per (pid, fd), which is how every
+ * consumer keys them); the runtime allocates below that band and
+ * fails loudly if a pathological run ever exhausts it. */
+constexpr int kFirstFd = 1000000;
 
 enum ReqOp : int32_t {
     REQ_LISTEN = 1,
@@ -112,6 +121,10 @@ struct Endpoint {
     bool is_timer = false;
     int64_t expirations = 0; /* timerfd credit awaiting timer_read */
     int32_t timer_gen = 0;   /* arm generation: stale COMP_TIMERs ignored */
+    /* v2 (interposer surface) connection state */
+    int32_t conn = 0;        /* 0 idle/in-progress, 1 established, -1 refused */
+    bool connect_started = false;
+    int32_t local_port = 0;  /* bind/listen port (getsockname) */
 };
 
 struct Proc {
@@ -131,14 +144,16 @@ struct Proc {
     int64_t block_result = 0;
     bool comp_ready = false;
     std::vector<int> poll_set; /* fds a BLK_POLL thread waits on */
+    std::vector<unsigned char> poll_want; /* per-fd interest (poll2);
+                                             empty = v1 read-interest */
     int32_t wake_gen = 0; /* sleep/poll-timeout generation: a wake for an
                              abandoned earlier block must not fire */
 
     std::map<int, Endpoint> fds;
-    int next_fd = kFirstFd;
 
     void* dl = nullptr;
     shim_main_fn entry = nullptr;
+    int (*posix_entry)(int, char**) = nullptr; /* plain `main` plugins */
     std::vector<std::string> argv_store;
     std::vector<char*> argv;
 };
@@ -150,6 +165,13 @@ struct Runtime {
     Proc* current = nullptr;
     long lmid = 0; /* next dlmopen namespace; -1 = exhausted, use dlopen */
     std::string err;
+    /* driver-pushed DNS table (name -> virtual IPv4, host order); static
+     * for a whole simulation, exactly like the reference's DNS registry
+     * (src/main/routing/dns.c) */
+    std::map<std::string, uint32_t> dns;
+    int32_t next_eph_port = 40000; /* ephemeral listen ports (bind :0) */
+    int next_fd = kFirstFd;        /* global shim-fd counter */
+    ShimAPI api{}; /* stable vtable handed to per-namespace interposers */
 };
 
 thread_local Runtime* g_rt = nullptr;
@@ -182,10 +204,18 @@ void block_here(Runtime* rt, Proc* p, int32_t kind, int32_t fd, int64_t n,
 
 /* ------------------------------------------------------------------ api */
 
+/* guarded shim-fd allocation: stops at the driver child-fd band — a
+ * loud failure beats silently aliasing an Endpoint */
+int rt_alloc_fd(Runtime* rt) {
+    if (rt->next_fd >= 2000000) return -1;
+    return rt->next_fd++;
+}
+
 int api_socket(void* vctx) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
-    int fd = p->next_fd++;
+    int fd = rt_alloc_fd(rt);
+    if (fd < 0) return -1;
     p->fds[fd]; /* default-construct the endpoint */
     return fd;
 }
@@ -196,6 +226,8 @@ int api_listen(void* vctx, int fd, int port) {
     auto it = p->fds.find(fd);
     if (it == p->fds.end()) return -1;
     it->second.listening = true;
+    if (port == 0) port = it->second.local_port; /* bound earlier */
+    it->second.local_port = port;
     push_req(rt, p->pid, REQ_LISTEN, fd, port, 0, nullptr);
     return 0;
 }
@@ -250,11 +282,14 @@ int64_t api_recv(void* vctx, int fd, void* buf, int64_t cap) {
     Proc* p = rt->current;
     auto it = p->fds.find(fd);
     if (it == p->fds.end() || cap < 0) return -1;
-    while (it->second.inbuf.empty() && !it->second.fin_rx) {
+    while (it->second.inbuf.empty() && !it->second.fin_rx &&
+           it->second.conn != -1) {
         block_here(rt, p, BLK_RECV, fd, cap, buf);
         it = p->fds.find(fd);
         if (it == p->fds.end()) return -1;
     }
+    if (it->second.conn == -1 && it->second.inbuf.empty())
+        return -1; /* connection refused: recv errors (ECONNREFUSED) */
     if (it->second.inbuf.empty()) return 0; /* FIN drained: EOF */
     int64_t n = static_cast<int64_t>(it->second.inbuf.size());
     if (n > cap) n = cap;
@@ -306,8 +341,9 @@ void api_log(void* vctx, const char* msg) {
 int api_pipe2(void* vctx, int* rfd, int* wfd) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
-    int r = p->next_fd++;
-    int w = p->next_fd++;
+    int r = rt_alloc_fd(rt);
+    int w = rt_alloc_fd(rt);
+    if (r < 0 || w < 0) return -1;
     Endpoint& re = p->fds[r];
     Endpoint& we = p->fds[w];
     re.is_pipe = we.is_pipe = true;
@@ -321,7 +357,8 @@ int api_pipe2(void* vctx, int* rfd, int* wfd) {
 int api_timer_create(void* vctx) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
-    int fd = p->next_fd++;
+    int fd = rt_alloc_fd(rt);
+    if (fd < 0) return -1;
     p->fds[fd].is_timer = true;
     return fd;
 }
@@ -366,7 +403,10 @@ bool fd_ready(Proc* p, int fd) {
     if (it == p->fds.end()) return true; /* error -> surface immediately */
     const Endpoint& e = it->second;
     if (e.is_timer) return e.expirations > 0;
-    return !e.inbuf.empty() || e.fin_rx || !e.accept_queue.empty();
+    /* a refused connect is read-ready too: POSIX reports POLLIN|POLLERR
+     * and recv() errors immediately on such a socket */
+    return !e.inbuf.empty() || e.fin_rx || !e.accept_queue.empty() ||
+           e.conn == -1;
 }
 
 int api_poll_fds(void* vctx, const int* fds, int nfds, int64_t timeout_ns) {
@@ -395,6 +435,181 @@ int api_poll_fds(void* vctx, const int* fds, int nfds, int64_t timeout_ns) {
     return mask_of();
 }
 
+/* -------------------------------------------------- v2: interposer api */
+
+int api_bind(void* vctx, int fd, int port) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    if (port == 0) port = rt->next_eph_port++;
+    it->second.local_port = port;
+    return port;
+}
+
+int api_connect_ip(void* vctx, int fd, uint32_t ip, int port, int nonblock) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    it->second.connect_started = true;
+    it->second.conn = 0;
+    /* name empty + a1 = ip marks the ip-keyed connect form for the driver */
+    push_req(rt, p->pid, REQ_CONNECT, fd, port, 0, nullptr,
+             static_cast<int64_t>(ip));
+    if (nonblock) return 0;
+    block_here(rt, p, BLK_CONNECT, fd, 0, nullptr);
+    return static_cast<int>(p->block_result);
+}
+
+uint32_t api_resolve(void* vctx, const char* name) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    if (!name) return 0;
+    auto it = rt->dns.find(name);
+    return it == rt->dns.end() ? 0 : it->second;
+}
+
+int api_try_accept(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || it->second.accept_queue.empty()) return -1;
+    int child = it->second.accept_queue.front();
+    it->second.accept_queue.pop_front();
+    return child;
+}
+
+int api_conn_status(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    return it->second.conn;
+}
+
+int64_t api_readable_n(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    return static_cast<int64_t>(it->second.inbuf.size());
+}
+
+int api_at_eof(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return 1;
+    return (it->second.fin_rx && it->second.inbuf.empty()) ? 1 : 0;
+}
+
+int api_writable(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return 0;
+    const Endpoint& e = it->second;
+    if (e.closed) return 0;
+    if (e.is_pipe || e.is_timer) return 1;
+    /* a never-connected socket (listener/child/bound) writes freely; an
+     * active open is writable once the handshake lands */
+    return (!e.connect_started || e.conn == 1) ? 1 : 0;
+}
+
+bool fd_ready2(Proc* p, int fd, unsigned char want) {
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return true; /* error -> surface immediately */
+    bool ready = false;
+    if (want & 1) ready = ready || fd_ready(p, fd);
+    if (want & 2) {
+        const Endpoint& e = it->second;
+        bool w = !e.closed && (e.is_pipe || e.is_timer ||
+                               !e.connect_started || e.conn == 1);
+        /* a refused connect must wake POLLOUT waiters too (they learn
+         * the failure from SO_ERROR/conn_status) */
+        ready = ready || w || e.conn == -1;
+    }
+    return ready;
+}
+
+int api_poll_many(void* vctx, const int* fds, const unsigned char* want,
+                  int nfds, int64_t timeout_ns, unsigned char* ready_out) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    if (nfds <= 0 || !ready_out) return -1;
+
+    auto fill = [&]() {
+        int n = 0;
+        for (int i = 0; i < nfds; i++) {
+            ready_out[i] = fd_ready2(p, fds[i], want[i]) ? 1 : 0;
+            n += ready_out[i];
+        }
+        return n;
+    };
+    int n = fill();
+    if (n || timeout_ns == 0) return n;
+    p->poll_set.assign(fds, fds + nfds);
+    p->poll_want.assign(want, want + nfds);
+    if (timeout_ns > 0) {
+        push_req(rt, p->pid, REQ_SLEEP, -1, ++p->wake_gen,
+                 rt->now_ns + timeout_ns, nullptr);
+    }
+    block_here(rt, p, BLK_POLL, -1, 0, nullptr);
+    p->wake_gen++;
+    p->poll_set.clear();
+    p->poll_want.clear();
+    return fill();
+}
+
+int api_poll2(void* vctx, const int* fds, const unsigned char* want,
+              int nfds, int64_t timeout_ns) {
+    if (nfds <= 0 || nfds > 31) return -1;
+    unsigned char ready[32] = {0};
+    int n = api_poll_many(vctx, fds, want, nfds, timeout_ns, ready);
+    if (n <= 0) return n;
+    int m = 0;
+    for (int i = 0; i < nfds; i++)
+        if (ready[i]) m |= 1 << i;
+    return m;
+}
+
+int api_fd_new(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    int fd = rt_alloc_fd(rt);
+    if (fd < 0) return -1;
+    p->fds[fd]; /* bare endpoint, no requests emitted */
+    return fd;
+}
+
+void api_proc_exit(void* vctx, int code) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    p->exit_code = code;
+    p->done = true;
+    push_req(rt, p->pid, REQ_EXIT, -1, 0, code, nullptr);
+    swapcontext(&p->ctx, &p->sched_ctx);
+    /* unreachable: a done proc is never resumed */
+}
+
+int api_sock_local_port(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return 0;
+    return it->second.local_port;
+}
+
+int api_current_pid(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    return rt->current ? rt->current->pid : -1;
+}
+
+const char* api_env_get(void* vctx, const char* name) {
+    (void)vctx;
+    return name ? getenv(name) : nullptr; /* base-namespace environ */
+}
+
 ShimAPI make_api(Runtime* rt) {
     ShimAPI a{};
     a.ctx = rt;
@@ -413,6 +628,21 @@ ShimAPI make_api(Runtime* rt) {
     a.timer_settime = api_timer_settime;
     a.timer_read = api_timer_read;
     a.poll_fds = api_poll_fds;
+    a.sock_bind = api_bind;
+    a.sock_connect_ip = api_connect_ip;
+    a.resolve = api_resolve;
+    a.try_accept = api_try_accept;
+    a.conn_status = api_conn_status;
+    a.readable_n = api_readable_n;
+    a.at_eof = api_at_eof;
+    a.writable = api_writable;
+    a.poll2 = api_poll2;
+    a.fd_new = api_fd_new;
+    a.proc_exit = api_proc_exit;
+    a.sock_local_port = api_sock_local_port;
+    a.current_pid = api_current_pid;
+    a.env_get = api_env_get;
+    a.poll_many = api_poll_many;
     return a;
 }
 
@@ -422,8 +652,22 @@ void proc_trampoline() {
     Runtime* rt = g_rt;
     Proc* p = rt->current;
     ShimAPI api = make_api(rt);
-    p->exit_code = p->entry(&api, static_cast<int>(p->argv.size()) - 1,
-                            p->argv.data());
+    int argc = static_cast<int>(p->argv.size()) - 1;
+    /* posix plugins (plain `main`, libc calls routed through the
+     * interposer .so in their namespace) vs shim_main plugins (explicit
+     * api vtable) — the two app tiers of SURVEY.md §7 step 6b */
+    p->exit_code = p->posix_entry ? p->posix_entry(argc, p->argv.data())
+                                  : p->entry(&api, argc, p->argv.data());
+    if (p->posix_entry) {
+        /* flush the plugin namespace's stdio: its libc never runs exit
+         * handlers when main returns to us, so buffered stdout would be
+         * lost (resolved through the plugin handle = that namespace's
+         * fflush) */
+        if (auto ff = reinterpret_cast<int (*)(void*)>(
+                dlsym(p->dl, "fflush"))) {
+            ff(nullptr);
+        }
+    }
     p->done = true;
     push_req(rt, p->pid, REQ_EXIT, -1, 0, p->exit_code, nullptr);
     swapcontext(&p->ctx, &p->sched_ctx);
@@ -441,7 +685,8 @@ bool runnable(const Proc* p) {
         case BLK_RECV: {
             auto it = p->fds.find(p->block_fd);
             if (it == p->fds.end()) return true; /* error path */
-            return !it->second.inbuf.empty() || it->second.fin_rx;
+            return !it->second.inbuf.empty() || it->second.fin_rx ||
+                   it->second.conn == -1;
         }
         case BLK_TIMER: {
             auto it = p->fds.find(p->block_fd);
@@ -450,8 +695,12 @@ bool runnable(const Proc* p) {
         }
         case BLK_POLL: {
             if (p->comp_ready) return true; /* poll timeout fired */
-            for (int fd : p->poll_set)
-                if (fd_ready(const_cast<Proc*>(p), fd)) return true;
+            Proc* q = const_cast<Proc*>(p);
+            for (size_t i = 0; i < p->poll_set.size(); i++) {
+                unsigned char w = i < p->poll_want.size() ? p->poll_want[i]
+                                                          : 1;
+                if (fd_ready2(q, p->poll_set[i], w)) return true;
+            }
             return false;
         }
     }
@@ -474,7 +723,15 @@ extern "C" {
 
 void* shim_init(void) {
     Runtime* rt = new Runtime();
+    rt->api = make_api(rt);
     return rt;
+}
+
+/* Register one name -> virtual-IPv4 (host order) mapping; the driver
+ * pushes the whole simulation's DNS registry after build (dns.c). */
+void shim_dns_add(void* vrt, const char* name, uint32_t ip) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (name) rt->dns[name] = ip;
 }
 
 void shim_free(void* vrt) {
@@ -516,7 +773,27 @@ int shim_spawn(void* vrt, int host_gid, const char* so_path,
     }
     p->entry = reinterpret_cast<shim_main_fn>(dlsym(p->dl, "shim_main"));
     if (!p->entry) {
-        rt->err = "plugin exports no shim_main";
+        /* unmodified-POSIX plugin: ordinary `main`, libc surface
+         * interposed by libshadow_interpose.so linked into the .so (the
+         * reference's LD_PRELOAD contract, interposer.c:37-48, realized
+         * per-namespace) */
+        p->posix_entry = reinterpret_cast<int (*)(int, char**)>(
+            dlsym(p->dl, "main"));
+    }
+    if (!p->entry && !p->posix_entry) {
+        rt->err = "plugin exports neither shim_main nor main";
+        dlclose(p->dl);
+        delete p;
+        return -1;
+    }
+    /* hand the api table to the interposer copy living in this plugin's
+     * namespace (pointers cross namespaces freely; symbols do not) */
+    typedef void (*install_fn)(const ShimAPI*);
+    if (auto install = reinterpret_cast<install_fn>(
+            dlsym(p->dl, "shadow_interpose_install"))) {
+        install(&rt->api);
+    } else if (p->posix_entry) {
+        rt->err = "posix plugin is not linked against libshadow_interpose";
         dlclose(p->dl);
         delete p;
         return -1;
@@ -565,16 +842,21 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
         Proc* p = rt->procs[c.pid];
         switch (c.op) {
             case COMP_CONNECT_OK:
-            case COMP_CONNECT_FAIL:
+            case COMP_CONNECT_FAIL: {
+                /* endpoint state first: nonblocking connects learn the
+                 * outcome via conn_status/SO_ERROR, not a blocked thread */
+                auto it = p->fds.find(c.fd);
+                if (it != p->fds.end())
+                    it->second.conn = (c.op == COMP_CONNECT_OK) ? 1 : -1;
                 if (p->blocked_on == BLK_CONNECT && p->block_fd == c.fd) {
                     p->block_result = (c.op == COMP_CONNECT_OK) ? 0 : -1;
                     p->comp_ready = true;
                 }
                 break;
+            }
             case COMP_ACCEPT: {
                 int child = static_cast<int>(c.r0);
                 p->fds[child]; /* create the endpoint */
-                if (child >= p->next_fd) p->next_fd = child + 1;
                 auto it = p->fds.find(c.fd);
                 if (it != p->fds.end()) it->second.accept_queue.push_back(child);
                 if (p->blocked_on == BLK_ACCEPT && p->block_fd == c.fd)

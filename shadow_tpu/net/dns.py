@@ -94,5 +94,9 @@ class DNS:
     def address_of(self, host_id: int) -> Address | None:
         return self._by_id.get(host_id)
 
+    def entries(self) -> list[Address]:
+        """All registered addresses (registration order)."""
+        return list(self._by_name.values())
+
     def __len__(self) -> int:
         return len(self._by_id)

@@ -45,13 +45,16 @@ class ShimComp(ctypes.Structure):
     ]
 
 
-def _compile(sources: list[str], out: str, extra: list[str]) -> str:
+def _compile(sources: list[str], out: str, extra: list[str],
+             cc: str | None = None) -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if os.path.exists(out) and all(
         os.path.getmtime(out) >= os.path.getmtime(s) for s in sources
     ):
         return out
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", out, *sources,
+    if cc is None:
+        cc = "gcc" if all(s.endswith(".c") for s in sources) else "g++"
+    cmd = [cc, "-O2", "-fPIC", "-shared", "-o", out, *sources,
            "-I", _SHIM_DIR, "-ldl", *extra]
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
@@ -66,6 +69,58 @@ def build_runtime() -> str:
         os.path.join(_BUILD_DIR, "libshim_runtime.so"),
         [],
     )
+
+
+_INTERPOSE_DIR = os.path.join(_REPO_ROOT, "native", "interpose")
+
+
+def build_interposer() -> str:
+    """Compile (if stale) and return libshadow_interpose.so — the libc
+    surface unmodified POSIX plugins link against (the reference's
+    libshadow-interpose.so role, src/preload/interposer.c)."""
+    return _compile(
+        [os.path.join(_INTERPOSE_DIR, "interpose.c")],
+        os.path.join(_BUILD_DIR, "libshadow_interpose.so"),
+        [],
+    )
+
+
+def compile_posix_plugin(
+    source: str, name: str | None = None, include_dirs: list[str] | None = None
+) -> str:
+    """Compile an UNMODIFIED POSIX source (ordinary `main`, plain libc
+    socket/poll/epoll/select calls) into a simulator plugin.
+
+    The source is built as a shared object linked against
+    libshadow_interpose ahead of libc, so inside its dlmopen namespace
+    every libc call it makes resolves to the interposer and runs against
+    the simulated stack — the reference's LD_PRELOAD contract
+    (src/preload/preload_defs.h:10-375) realized per-namespace. The
+    compat include dir supplies a minimal <glib.h> so reference test
+    sources build as-is.
+    """
+    interposer = build_interposer()
+    base = name or os.path.splitext(os.path.basename(source))[0]
+    out = os.path.join(_BUILD_DIR, f"lib{base}.so")
+    deps = [source, interposer]
+    if os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in deps
+    ):
+        return out
+    cc = "g++" if source.endswith(("cc", "cpp")) else "gcc"
+    cmd = [
+        cc, "-O1", "-fPIC", "-shared", "-o", out, source,
+        "-I", os.path.join(_INTERPOSE_DIR, "compat"),
+        *sum([["-I", d] for d in (include_dirs or [])], []),
+        "-L", _BUILD_DIR, "-lshadow_interpose",
+        f"-Wl,-rpath,{_BUILD_DIR}", "-Wl,--no-as-needed",
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"posix plugin build failed:\n{' '.join(cmd)}\n{res.stderr}"
+        )
+    return out
 
 
 def compile_plugin(source: str, name: str | None = None) -> str:
@@ -113,6 +168,9 @@ class ShimRuntime:
         lib.shim_proc_exit_code.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
         ]
+        lib.shim_dns_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
         self._lib = lib
         self._rt = lib.shim_init()
         self._req_buf = (ShimReq * max_reqs)()
@@ -156,6 +214,11 @@ class ShimRuntime:
 
     def wire_fin(self, pid, fd) -> None:
         self._lib.shim_wire_fin(self._rt, pid, fd)
+
+    def dns_add(self, name: str, ip: int) -> None:
+        """Push one name -> virtual-IPv4 (host order) mapping for the
+        interposer's getaddrinfo (dns.c registry semantics)."""
+        self._lib.shim_dns_add(self._rt, name.encode(), ip)
 
     def exit_code(self, pid: int) -> int | None:
         done = ctypes.c_int(0)

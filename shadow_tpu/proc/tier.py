@@ -94,6 +94,10 @@ class ProcessTier:
             raise NotImplementedError("ProcessTier is single-shard for now")
         self.rt = ShimRuntime()
         self.n_sockets = n_sockets
+        # the interposer's getaddrinfo resolves against the runtime's DNS
+        # table; push the whole (static) registry up front (dns.c role)
+        for addr in self.sim.dns.entries():
+            self.rt.dns_add(addr.name, addr.ip)
         self.logs: list[tuple[int, int, str]] = []  # (sim_ns, pid, msg)
         self.exit_codes: dict[int, int] = {}
 
@@ -154,7 +158,10 @@ class ProcessTier:
         return p
 
     def _alloc_fd(self, pid: int) -> int:
-        f = self._next_fd.get(pid, 1000)
+        # driver-assigned child fds live in the 2'000'000+ band, disjoint
+        # from the runtime's own 1'000'000+ allocations (shim_runtime.cpp
+        # kFirstFd) — fd numbers stay globally unique
+        f = self._next_fd.get(pid, 2_000_000)
         self._next_fd[pid] = f + 1
         return f
 
@@ -172,7 +179,12 @@ class ProcessTier:
                 rows.append((gid, [CMD_LISTEN, slot, int(r.port)]))
             elif r.op == REQ_CONNECT:
                 name = r.name.decode()
-                addr = self.sim.dns.resolve_name(name)
+                if name:
+                    addr = self.sim.dns.resolve_name(name)
+                else:
+                    # interposer form: a1 carries the virtual IPv4 from
+                    # connect(sockaddr_in) (host order)
+                    addr = self.sim.dns.resolve_ip(int(r.a1))
                 if addr is None:
                     self._pending_comps.append(
                         (pid, COMP_CONNECT_FAIL, fd, 0)

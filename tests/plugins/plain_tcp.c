@@ -1,0 +1,205 @@
+/* plain_tcp.c — an ORDINARY POSIX TCP program: no simulator headers, no
+ * ShimAPI, just main() + libc. It runs inside shadow-tpu because the
+ * build links it against libshadow_interpose ahead of libc
+ * (compile_posix_plugin), proving the unmodified-source contract the
+ * reference meets with LD_PRELOAD (its equivalent workload:
+ * /root/reference/src/test/tcp/test_tcp.c).
+ *
+ * usage: plain_tcp <blocking|nonblocking-poll|nonblocking-epoll|
+ *                   nonblocking-select> <client server_name port nbytes |
+ *                   server port>
+ *
+ * The client sends nbytes of patterned data; the server echoes
+ * everything back until EOF; the client verifies the echo and prints
+ * "PLAIN_TCP_OK <nbytes> <ms>".
+ */
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <time.h>
+#include <unistd.h>
+
+typedef enum { WAIT_READ, WAIT_WRITE } waitkind;
+
+static const char* g_mode = "blocking";
+
+static int iowait(int fd, waitkind k) {
+    if (!strcmp(g_mode, "nonblocking-poll")) {
+        struct pollfd p;
+        memset(&p, 0, sizeof p);
+        p.fd = fd;
+        p.events = (k == WAIT_READ) ? POLLIN : POLLOUT;
+        return poll(&p, 1, -1) > 0 ? 0 : -1;
+    }
+    if (!strcmp(g_mode, "nonblocking-epoll")) {
+        int ep = epoll_create(1);
+        struct epoll_event ev, out;
+        memset(&ev, 0, sizeof ev);
+        ev.events = (k == WAIT_READ) ? EPOLLIN : EPOLLOUT;
+        ev.data.fd = fd;
+        if (epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev) < 0) return -1;
+        int n = epoll_wait(ep, &out, 1, -1);
+        close(ep);
+        return n > 0 ? 0 : -1;
+    }
+    if (!strcmp(g_mode, "nonblocking-select")) {
+        fd_set set;
+        FD_ZERO(&set);
+        FD_SET(fd, &set);
+        int n = (k == WAIT_READ) ? select(fd + 1, &set, NULL, NULL, NULL)
+                                 : select(fd + 1, NULL, &set, NULL, NULL);
+        return n > 0 ? 0 : -1;
+    }
+    return 0; /* blocking mode never waits explicitly */
+}
+
+static int nonblocking(void) { return strcmp(g_mode, "blocking") != 0; }
+
+static int run_server(int port) {
+    int ls = socket(AF_INET, SOCK_STREAM | (nonblocking() ? SOCK_NONBLOCK : 0), 0);
+    if (ls < 0) return 10;
+    struct sockaddr_in a;
+    memset(&a, 0, sizeof a);
+    a.sin_family = AF_INET;
+    a.sin_port = htons((unsigned short)port);
+    if (bind(ls, (struct sockaddr*)&a, sizeof a) < 0) return 11;
+    if (listen(ls, 8) < 0) return 12;
+
+    int cs;
+    for (;;) {
+        cs = accept(ls, NULL, NULL);
+        if (cs >= 0) break;
+        if (errno != EAGAIN) return 13;
+        if (iowait(ls, WAIT_READ) < 0) return 14;
+    }
+
+    char buf[4096];
+    long total = 0;
+    for (;;) {
+        ssize_t n = recv(cs, buf, sizeof buf, 0);
+        if (n == 0) break; /* client FIN */
+        if (n < 0) {
+            if (errno == EAGAIN) {
+                if (iowait(cs, WAIT_READ) < 0) return 15;
+                continue;
+            }
+            return 16;
+        }
+        total += n;
+        ssize_t off = 0;
+        while (off < n) {
+            ssize_t w = send(cs, buf + off, (size_t)(n - off), 0);
+            if (w < 0) {
+                if (errno == EAGAIN) {
+                    if (iowait(cs, WAIT_WRITE) < 0) return 17;
+                    continue;
+                }
+                return 18;
+            }
+            off += w;
+        }
+    }
+    printf("PLAIN_TCP_SERVER_DONE %ld\n", total);
+    close(cs);
+    close(ls);
+    return 0;
+}
+
+static int run_client(const char* server, int port, long nbytes) {
+    char service[16];
+    snprintf(service, sizeof service, "%d", port);
+    struct addrinfo hints, *info = NULL;
+    memset(&hints, 0, sizeof hints);
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(server, service, &hints, &info) != 0) return 20;
+
+    int fd = socket(AF_INET, SOCK_STREAM | (nonblocking() ? SOCK_NONBLOCK : 0), 0);
+    if (fd < 0) return 21;
+    struct timeval t0, t1;
+    gettimeofday(&t0, NULL);
+    if (connect(fd, info->ai_addr, info->ai_addrlen) < 0) {
+        if (errno != EINPROGRESS) return 22;
+        if (iowait(fd, WAIT_WRITE) < 0) return 23;
+        int err = 0;
+        socklen_t elen = sizeof err;
+        if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) < 0 || err)
+            return 24;
+    }
+    freeaddrinfo(info);
+
+    char block[1024];
+    for (int i = 0; i < (int)sizeof block; i++) block[i] = (char)('a' + i % 26);
+
+    long sent = 0;
+    while (sent < nbytes) {
+        size_t chunk = sizeof block;
+        if ((long)chunk > nbytes - sent) chunk = (size_t)(nbytes - sent);
+        ssize_t w = send(fd, block, chunk, 0);
+        if (w < 0) {
+            if (errno == EAGAIN) {
+                if (iowait(fd, WAIT_WRITE) < 0) return 25;
+                continue;
+            }
+            return 26;
+        }
+        sent += w;
+    }
+    shutdown(fd, SHUT_WR); /* tell the server we're done sending */
+
+    long got = 0;
+    char in[4096];
+    while (got < nbytes) {
+        ssize_t n = recv(fd, in, sizeof in, 0);
+        if (n == 0) break;
+        if (n < 0) {
+            if (errno == EAGAIN) {
+                if (iowait(fd, WAIT_READ) < 0) return 27;
+                continue;
+            }
+            return 28;
+        }
+        for (ssize_t i = 0; i < n; i++) {
+            /* pattern repeats every 1024 bytes, alphabet every 26 */
+            char want = (char)('a' + ((got + i) % sizeof block) % 26);
+            if (in[i] != want) {
+                printf("PLAIN_TCP_CORRUPT at %ld\n", got + i);
+                return 29;
+            }
+        }
+        got += n;
+    }
+    gettimeofday(&t1, NULL);
+    if (got != nbytes) {
+        printf("PLAIN_TCP_SHORT %ld/%ld\n", got, nbytes);
+        return 30;
+    }
+    long ms = (t1.tv_sec - t0.tv_sec) * 1000 + (t1.tv_usec - t0.tv_usec) / 1000;
+    printf("PLAIN_TCP_OK %ld %ld\n", got, ms);
+    close(fd);
+    return 0;
+}
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s mode client|server ...\n", argv[0]);
+        return 2;
+    }
+    g_mode = argv[1];
+    if (!strcmp(argv[2], "server")) {
+        return run_server(argc > 3 ? atoi(argv[3]) : 8080);
+    }
+    if (!strcmp(argv[2], "client")) {
+        if (argc < 6) return 2;
+        return run_client(argv[3], atoi(argv[4]), atol(argv[5]));
+    }
+    return 2;
+}
