@@ -130,6 +130,7 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
     wall = time.perf_counter() - t0
 
     executed = int(st.stats.n_executed.sum())
+    sweeps = int(st.stats.n_sweeps)
     dev = jax.devices()[0]
     return {
         "events": executed,
@@ -137,6 +138,11 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
         "events_per_s": executed / wall,
         "sim_s_per_wall_s": stop_s / wall,
         "windows": int(st.stats.n_windows),
+        # scheduler self-profiling (scheduler.c:266-271 analog): sweeps
+        # are the unit of fixed overhead (sort + merge + push); high
+        # events/sweep is what the batched drain buys
+        "sweeps": sweeps,
+        "events_per_sweep": round(executed / max(sweeps, 1), 1),
         "drops": int(st.queues.drops.sum()),
         "device": str(dev.device_kind),
         "n_hosts": N_HOSTS,

@@ -75,6 +75,11 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=["codel", "static", "single"],
                    help="upstream router queue manager "
                         "(router.c:50-55 QUEUE_MANAGER_*)")
+    p.add_argument("--locality", action="store_true",
+                   help="reorder hosts at build time so config-visible "
+                        "traffic partners share a shard (sharded runs; "
+                        "replaces the reference's random host shuffle + "
+                        "work stealing)")
     p.add_argument("--mesh", type=int, default=0,
                    help="shard hosts over N devices (0 = single device; "
                         "the TPU-era --workers)")
@@ -171,8 +176,6 @@ def main(argv=None) -> int:
             unsupported.append("--resume")
         if args.checkpoint_interval:
             unsupported.append("--checkpoint-interval")
-        if args.mesh:
-            unsupported.append("--mesh")
         if unsupported:
             print(
                 "error: the process tier (native .so plugins) does not "
@@ -183,13 +186,19 @@ def main(argv=None) -> int:
             return 2
 
         t0 = time.perf_counter()
+        tier_mesh = None
+        if args.mesh:
+            from shadow_tpu.parallel.mesh import make_mesh
+
+            tier_mesh = make_mesh(args.mesh, dcn_slices=args.dcn_slices)
         tier = ProcessTier(
             cfg, seed=args.seed, n_sockets=args.sockets,
             capacity=args.capacity,
             strict_overflow=not args.allow_queue_overflow,
             tcp_cc=args.tcp_congestion_control,
             rx_queue=args.router_queue, qdisc=args.interface_qdisc,
-            interface_buffer=args.interface_buffer,
+            interface_buffer=args.interface_buffer, mesh=tier_mesh,
+            locality=args.locality,
         )
         st = tier.run()
         wall = time.perf_counter() - t0
@@ -224,7 +233,7 @@ def main(argv=None) -> int:
         cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity,
         mesh=mesh, tcp_cc=args.tcp_congestion_control,
         rx_queue=args.router_queue, qdisc=args.interface_qdisc,
-        interface_buffer=args.interface_buffer,
+        interface_buffer=args.interface_buffer, locality=args.locality,
     )
     if args.allow_queue_overflow:
         sim.strict_overflow = False

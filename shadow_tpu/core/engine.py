@@ -129,13 +129,22 @@ class Stats:
     n_net_dropped: jax.Array  # i64[H] packets lost to reliability rolls
     n_windows: jax.Array  # i64[] (replicated across shards)
     n_by_kind: jax.Array  # i64[H, NK] executed events per handler kind
+    # scheduler self-profiling (the reference logs per-thread barrier
+    # waits and push/pop idle time every run, scheduler.c:266-271;
+    # the lockstep analogs are sweep and collective-round counts):
+    n_sweeps: jax.Array  # i64[] outer drain iterations (queue merges)
+    n_inner_steps: jax.Array  # i64[] sequential frontier positions run
+    n_xchg_rounds: jax.Array  # i64[] cross-shard all_to_all rounds
+    n_cross_shard: jax.Array  # i64[] packets delivered across shards
 
     @staticmethod
     def create(n_hosts: int, n_kinds: int = 1) -> "Stats":
         z = jnp.zeros((n_hosts,), jnp.int64)
+        s = jnp.zeros((), jnp.int64)
         return Stats(
-            z, z, z, jnp.zeros((), jnp.int64),
+            z, z, z, s,
             jnp.zeros((n_hosts, n_kinds), jnp.int64),
+            s, s, s, s,
         )
 
 
@@ -267,6 +276,11 @@ class Engine:
             return jax.lax.psum(x.astype(jnp.int32), self.cfg.axis_name) > 0
         return x
 
+    def _gsum(self, x: jax.Array) -> jax.Array:
+        if self.cfg.axis_name is not None:
+            return jax.lax.psum(x, self.cfg.axis_name)
+        return x
+
     def _exchange_push(self, q: EventQueue, ev: Events, mask: jax.Array, host0):
         """Push a flat routed batch, delivering cross-shard events by
         bucketed all_to_all.
@@ -281,8 +295,9 @@ class Engine:
         reference's shared-memory scheduler_push across threads,
         scheduler.c:342-360; SURVEY.md §2.4).
         """
+        z = jnp.zeros((), jnp.int64)
         if self.cfg.axis_name is None:
-            return queue_push(q, ev, mask, host0)
+            return queue_push(q, ev, mask, host0), z, z
         cfg = self.cfg
         ax = cfg.axis_name
         h, s = cfg.n_hosts, cfg.n_shards
@@ -303,11 +318,11 @@ class Engine:
         pos = jnp.arange(m, dtype=jnp.int32)
 
         def cond(carry):
-            _, rem = carry
+            rem = carry[1]
             return jax.lax.psum(jnp.any(rem).astype(jnp.int32), ax) > 0
 
         def body(carry):
-            q, rem = carry
+            q, rem, rounds = carry
             dkey = jnp.where(rem, dshard, s)
             order = jnp.argsort(dkey, stable=True)
             sd = dkey[order]
@@ -334,10 +349,17 @@ class Engine:
             recv_flat = recv.flatten()
             q2 = queue_push(q, recv_flat, recv_flat.time != TIME_INVALID, host0)
             sent = jnp.zeros((m,), bool).at[order].set(sel)
-            return q2, rem & ~sent
+            return q2, rem & ~sent, rounds + 1
 
-        q, _ = jax.lax.while_loop(cond, body, (q, remaining))
-        return q
+        # global count (each shard only sees its own outbound packets;
+        # the replicated stats scalar needs the psum'd total)
+        n_cross = jax.lax.psum(
+            jnp.sum(remaining, dtype=jnp.int64), ax
+        )
+        q, _, rounds = jax.lax.while_loop(
+            cond, body, (q, remaining, jnp.zeros((), jnp.int64))
+        )
+        return q, rounds, n_cross
 
     # -- state construction -------------------------------------------------
     def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
@@ -565,8 +587,14 @@ class Engine:
             q = dataclasses.replace(
                 q, time=jnp.where(cleared, TIME_INVALID, q.time)
             )
-            q = self._exchange_push(
+            q, xr, nc = self._exchange_push(
                 q, out.flatten(), final_mask.reshape(-1), host0
+            )
+            stats2 = dataclasses.replace(
+                stats2,
+                n_sweeps=stats2.n_sweeps + 1,
+                n_xchg_rounds=stats2.n_xchg_rounds + xr,
+                n_cross_shard=stats2.n_cross_shard + nc,
             )
             return (q, hosts, src_seq, exec_cnt, stats2)
 
@@ -659,6 +687,9 @@ class Engine:
                 emask = upd(emask, fmask)
                 executed = upd(executed, active)
                 min_emit = jnp.minimum(min_emit, jnp.min(local_below, axis=1))
+                stats = dataclasses.replace(
+                    stats, n_inner_steps=stats.n_inner_steps + 1
+                )
                 return (bi + 1, hosts, src_seq, exec_cnt, stats, min_emit,
                         ebuf, emask, executed, cpu_free)
 
@@ -680,8 +711,14 @@ class Engine:
             q = dataclasses.replace(
                 q, time=jnp.where(cleared, TIME_INVALID, q.time)
             )
-            q = self._exchange_push(
+            q, xr, nc = self._exchange_push(
                 q, ebuf.flatten(), emask.reshape(-1), host0
+            )
+            stats = dataclasses.replace(
+                stats,
+                n_sweeps=stats.n_sweeps + 1,
+                n_xchg_rounds=stats.n_xchg_rounds + xr,
+                n_cross_shard=stats.n_cross_shard + nc,
             )
             return (q, hosts, src_seq, exec_cnt, stats, cpu_free)
 
@@ -690,13 +727,20 @@ class Engine:
         q, hosts, src_seq, exec_cnt, stats, cpu_free = jax.lax.while_loop(
             outer_cond, outer_body, carry
         )
+        # each shard's inner loop trips independently; fold this window's
+        # delta across shards so the counter stays replicated-consistent
+        inner = st.stats.n_inner_steps + self._gsum(
+            stats.n_inner_steps - st.stats.n_inner_steps
+        )
         return dataclasses.replace(
             st,
             queues=q,
             hosts=hosts,
             src_seq=src_seq,
             exec_cnt=exec_cnt,
-            stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
+            stats=dataclasses.replace(
+                stats, n_windows=stats.n_windows + 1, n_inner_steps=inner
+            ),
             cpu_free=cpu_free,
         )
 

@@ -41,6 +41,10 @@ class ProcApp:
 
     gid: jax.Array  # i32[H]
     fin_seen: jax.Array  # bool[H, S] — stream EOF consumed per socket
+    fin_gen: jax.Array  # i32[H, S] — conn incarnation fin_seen belongs to
+    # (device child-slot reuse bumps tcb.conn_gen without any driver
+    # bind, so a sticky fin_seen from the previous connection must be
+    # reset lazily when a new incarnation's first delivery arrives)
 
 
 class ProcTierModel:
@@ -65,6 +69,7 @@ class ProcTierModel:
         state = ProcApp(
             gid=jnp.arange(n, dtype=_I32),
             fin_seen=jnp.zeros((n, b.n_sockets), bool),
+            fin_gen=jnp.zeros((n, b.n_sockets), _I32),
         )
         return state, self._make_handlers, self._on_recv
 
@@ -114,12 +119,21 @@ class ProcTierModel:
         return hs, emit_concat(em_conn, em_send, em_close)
 
     def _on_recv(self, hs, slot, pkt, now, key):
-        eof = (slot >= 0) & ((pkt.flags & F_FIN) != 0)
+        got = slot >= 0
+        eof = got & ((pkt.flags & F_FIN) != 0)
         s = jnp.maximum(slot, 0)
-        fin = hs.app.fin_seen.at[s].set(
-            jnp.where(eof, True, hs.app.fin_seen[s])
+        app = hs.app
+        # lazy per-incarnation reset: if this slot's TCB was reused since
+        # fin_seen was last written, the sticky EOF belongs to a previous
+        # connection and must clear before this delivery is applied
+        cur_gen = hs.net.tcb.conn_gen[s]
+        stale = got & (app.fin_gen[s] != cur_gen)
+        fin0 = jnp.where(stale, False, app.fin_seen[s])
+        fin = app.fin_seen.at[s].set(jnp.where(eof, True, fin0))
+        fgen = app.fin_gen.at[s].set(
+            jnp.where(got, cur_gen, app.fin_gen[s])
         )
         hs = dataclasses.replace(
-            hs, app=dataclasses.replace(hs.app, fin_seen=fin)
+            hs, app=dataclasses.replace(app, fin_seen=fin, fin_gen=fgen)
         )
         return hs, Emit.none(1, N_PKT_ARGS)

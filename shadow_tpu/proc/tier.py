@@ -67,7 +67,7 @@ from shadow_tpu.proc.native import (
 )
 from shadow_tpu.sim import build_simulation
 from shadow_tpu.transport.stack import N_PKT_ARGS
-from shadow_tpu.transport.tcp import CLOSED, ESTABLISHED, SYN_SENT
+from shadow_tpu.transport.tcp import CLOSED, ESTABLISHED
 
 
 class ProcessTier:
@@ -82,17 +82,29 @@ class ProcessTier:
                  n_sockets: int = 8, capacity: int | None = None,
                  strict_overflow: bool = True, tcp_cc: str = "reno",
                  rx_queue: str = "codel", qdisc: str = "fifo",
-                 interface_buffer: int = 1_024_000):
+                 interface_buffer: int = 1_024_000, mesh=None,
+                 driver_slots: int | None = None, locality: bool = False):
         self.strict_overflow = strict_overflow
         self.model = ProcTierModel()
+        # hard slot-space split: device-created children live in
+        # [0, child_limit), driver-owned sockets in [child_limit, S).
+        # Without it, a recycled driver slot could be claimed by an
+        # inbound SYN while the driver still holds it in its free list.
+        if driver_slots is None:
+            driver_slots = min(max(1, n_sockets // 2), n_sockets - 1)
+        if not 0 < driver_slots < n_sockets:
+            raise ValueError(
+                f"driver_slots must be in (0, {n_sockets}), got {driver_slots}"
+            )
+        self._child_limit = n_sockets - driver_slots
         self.sim = build_simulation(
             cfg, seed=seed, n_sockets=n_sockets, capacity=capacity,
             app_model=self.model, tcp_cc=tcp_cc, rx_queue=rx_queue,
-            qdisc=qdisc, interface_buffer=interface_buffer,
+            qdisc=qdisc, interface_buffer=interface_buffer, mesh=mesh,
+            tcp_child_slot_limit=self._child_limit, locality=locality,
         )
-        if self.sim.mesh is not None:
-            raise NotImplementedError("ProcessTier is single-shard for now")
         self.rt = ShimRuntime()
+        self.lost_stream_bytes = 0  # bytes unflushable at endpoint drop
         self.n_sockets = n_sockets
         # the interposer's getaddrinfo resolves against the runtime's DNS
         # table; push the whole (static) registry up front (dns.c role)
@@ -107,7 +119,14 @@ class ProcessTier:
         self.listen_ep: dict[tuple[int, int], tuple[int, int]] = {}
         self.pending_conn: dict[tuple[int, int], tuple[int, int]] = {}
         self.wire: dict[tuple[int, int], tuple[int, int]] = {}  # slot<->slot
-        self.undelivered: dict[tuple[int, int], int] = {}
+        # full-4-tuple wire index: (gid, lport, peer_gid, pport) -> (gid,
+        # slot). The reference demuxes by the same 4-tuple key
+        # (network_interface.c:375-455); matching on it makes parallel
+        # same-port connects between one host pair unambiguous.
+        self._four: dict[tuple[int, int, int, int], tuple[int, int]] = {}
+        self._four_key: dict[tuple[int, int], tuple] = {}  # ep -> its key
+        self._driver_owned: set[tuple[int, int]] = set()
+        self._free_slots: dict[int, list[int]] = {}
         self.pid_host: dict[int, int] = {}
         self._next_slot: dict[int, int] = {}
         self._next_sport: dict[int, int] = {}
@@ -141,16 +160,97 @@ class ProcessTier:
         h_n = len(self.sim.names)
         self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
         self._prev_fin = np.zeros((h_n, n_sockets), bool)
+        # vectorized-observe state: endpoint membership, per-slot owed
+        # bytes, and the device TCB's slot-incarnation counter (conn_gen)
+        # for robust reuse detection
+        self._known = np.zeros((h_n, n_sockets), bool)
+        self._undeliv = np.zeros((h_n, n_sockets), np.int64)
+        self._prev_gen = np.zeros((h_n, n_sockets), np.int32)
 
     # ------------------------------------------------------------- helpers
     def _alloc_slot(self, gid: int) -> int:
-        # driver-owned slots grow downward from the top; TCP child sockets
-        # allocate first-free from 0 upward, so the ends never collide
+        # driver-owned slots grow downward from the top (TCP child
+        # sockets allocate first-free from 0 upward, so the ends never
+        # collide); slots freed by completed close handshakes recycle
+        # first, so connection churn no longer exhausts the table
+        free = self._free_slots.get(gid)
+        if free:
+            return free.pop()
         s = self._next_slot.get(gid, self.n_sockets - 1)
         self._next_slot[gid] = s - 1
-        if s < 1:
-            raise RuntimeError(f"host {gid}: out of socket slots")
+        if s < self._child_limit:
+            raise RuntimeError(
+                f"host {gid}: out of driver socket slots (reserved "
+                f"[{self._child_limit}, {self.n_sockets}); raise "
+                "n_sockets or driver_slots)"
+            )
         return s
+
+    def _register_ep(self, gid: int, slot: int, pid: int, fd: int,
+                     driver_owned: bool) -> None:
+        self.ep_of[(gid, slot)] = (pid, fd)
+        self.slot_of[(pid, fd)] = (gid, slot)
+        self._known[gid, slot] = True
+        self._undeliv[gid, slot] = 0
+        self._prev_fin[gid, slot] = False  # fresh incarnation baseline
+        if driver_owned:
+            self._driver_owned.add((gid, slot))
+
+    def _drop_ep(self, gid: int, slot: int, *, recycle: bool,
+                 surface_eof: bool = False) -> None:
+        """Forget one endpoint's mappings, flushing owed bytes in BOTH
+        wire directions first (the endpoints' byte streams outlive the
+        slot mapping in the native runtime, so a final flush here keeps
+        a peer from being stranded mid-stream). Optionally recycles a
+        driver-owned slot and surfaces EOF to the dropped side."""
+        key = (gid, slot)
+        ep = self.ep_of.pop(key, None)
+        peer = self.wire.pop(key, None)
+        if peer is not None:
+            self.wire.pop(peer, None)
+        if ep is not None:
+            pid, fd = ep
+            if peer is not None and peer in self.ep_of:
+                ppid, pfd = self.ep_of[peer]
+                # 1. bytes this reader is still owed from its peer
+                owed = int(self._undeliv[key])
+                if owed:
+                    moved = self.rt.wire_deliver(ppid, pfd, pid, fd, owed)
+                    self._undeliv[key] -= max(moved, 0)
+                # 2. bytes the peer is still owed from this endpoint —
+                # after this drop nothing would route them
+                powed = int(self._undeliv[peer])
+                if powed:
+                    moved = self.rt.wire_deliver(pid, fd, ppid, pfd, powed)
+                    self._undeliv[peer] -= max(moved, 0)
+            if self._undeliv[key]:
+                self.lost_stream_bytes += int(self._undeliv[key])
+            if surface_eof:
+                self.rt.wire_fin(pid, fd)
+            self.slot_of.pop(ep, None)
+        fk = self._four_key.pop(key, None)
+        if fk is not None:
+            self._four.pop(fk, None)
+        self.pending_conn.pop(key, None)
+        self._known[gid, slot] = False
+        self._undeliv[gid, slot] = 0
+        if key in self._driver_owned:
+            self._driver_owned.discard(key)
+            if recycle:
+                self._free_slots.setdefault(gid, []).append(slot)
+
+    def _wire_try_pair(self, gid: int, slot: int, lport: int,
+                       peer_gid: int, pport: int) -> None:
+        """Index an endpoint by its connection 4-tuple and pair it with
+        the reverse tuple's endpoint when that side exists."""
+        key = (gid, slot)
+        fk = (gid, lport, peer_gid, pport)
+        self._four[fk] = key
+        self._four_key[key] = fk
+        other = self._four.get((peer_gid, pport, gid, lport))
+        if other is not None and other != key:
+            self.wire[key] = other
+            self.wire[other] = key
 
     def _alloc_sport(self, gid: int) -> int:
         p = self._next_sport.get(gid, EPHEMERAL_BASE + 4096)
@@ -173,8 +273,7 @@ class ProcessTier:
             gid = self.pid_host[pid]
             if r.op == REQ_LISTEN:
                 slot = self._alloc_slot(gid)
-                self.slot_of[(pid, fd)] = (gid, slot)
-                self.ep_of[(gid, slot)] = (pid, fd)
+                self._register_ep(gid, slot, pid, fd, driver_owned=True)
                 self.listen_ep[(gid, int(r.port))] = (pid, fd)
                 rows.append((gid, [CMD_LISTEN, slot, int(r.port)]))
             elif r.op == REQ_CONNECT:
@@ -192,9 +291,10 @@ class ProcessTier:
                     continue
                 slot = self._alloc_slot(gid)
                 sport = self._alloc_sport(gid)
-                self.slot_of[(pid, fd)] = (gid, slot)
-                self.ep_of[(gid, slot)] = (pid, fd)
+                self._register_ep(gid, slot, pid, fd, driver_owned=True)
                 self.pending_conn[(gid, slot)] = (pid, fd)
+                self._wire_try_pair(gid, slot, sport, addr.host_id,
+                                    int(r.port))
                 rows.append(
                     (gid, [CMD_CONNECT, slot, sport, addr.host_id,
                            int(r.port)])
@@ -256,86 +356,98 @@ class ProcessTier:
 
     # ------------------------------------------------------------ observe
     def _observe(self, st) -> None:
-        """Diff device tables into completions + byte/FIN wire ops."""
-        net = st.hosts.net
-        tstate = np.array(jax.device_get(net.tcb.state))
-        rx = np.array(jax.device_get(net.sockets.rx_bytes))
-        fin = np.array(jax.device_get(st.hosts.app.fin_seen))
-        lport = np.array(jax.device_get(net.sockets.local_port))
-        phost = np.array(jax.device_get(net.sockets.peer_host))
-        pport = np.array(jax.device_get(net.sockets.peer_port))
+        """Diff device tables into completions + byte/FIN wire ops.
 
-        # pending active opens
+        One batched device_get per window; every scan below walks only
+        numpy-selected CHANGED entries, never the full [H, S] table in
+        Python (the round-2 version's per-slot loops were O(hosts x
+        slots) per window — hopeless at 1k processes)."""
+        net = st.hosts.net
+        tstate, rx, fin_raw, fgen, lport, phost, pport, cgen = (
+            np.asarray(x)
+            for x in jax.device_get((
+                net.tcb.state, net.sockets.rx_bytes, st.hosts.app.fin_seen,
+                st.hosts.app.fin_gen, net.sockets.local_port,
+                net.sockets.peer_host, net.sockets.peer_port,
+                net.tcb.conn_gen,
+            ))
+        )
+        # a fin_seen flag only counts for the slot incarnation it was
+        # recorded against; a sticky flag from a previous connection on a
+        # reused slot must not read as this stream's EOF
+        fin = fin_raw & (fgen == cgen)
+
+        # accumulate this window's delivered-byte deltas FIRST (against
+        # the pre-drop _known mask): bytes that land in the same window
+        # an endpoint's slot turns over must reach the drop-time flush,
+        # not vanish with the _known clear
+        self._undeliv += np.where(self._known,
+                                  np.maximum(rx - self._prev_rx, 0), 0)
+        self._prev_rx = rx
+
+        # 0. slot incarnation changed under a live endpoint: the device
+        # TCP closed and reset the slot (every path back to CLOSED goes
+        # through _fresh_row_like's conn_gen bump — tcp.py RST/final-ACK
+        # frees and TIME_WAIT expiry). The old incarnation's stream is
+        # over: flush owed bytes, surface EOF, recycle driver slots.
+        for gid, slot in zip(*np.nonzero((cgen != self._prev_gen)
+                                         & self._known)):
+            key = (int(gid), int(slot))
+            if key in self.pending_conn:
+                continue  # refused connect: handled below as CLOSED
+            self._drop_ep(*key, recycle=True, surface_eof=True)
+
+        # 1. pending active opens resolve
         for key, (pid, fd) in list(self.pending_conn.items()):
-            gid, slot = key
-            s = tstate[gid, slot]
+            s = tstate[key]
             if s >= ESTABLISHED:
                 self._pending_comps.append((pid, COMP_CONNECT_OK, fd, 0))
                 del self.pending_conn[key]
             elif s == CLOSED:
                 self._pending_comps.append((pid, COMP_CONNECT_FAIL, fd, 0))
-                del self.pending_conn[key]
-                del self.ep_of[key]
-                del self.slot_of[(pid, fd)]
+                self._drop_ep(*key, recycle=True)
 
-        # new child sockets on listening hosts -> accepts
-        for (gid, port), (lpid, lfd) in self.listen_ep.items():
-            for slot in range(tstate.shape[1]):
-                if (gid, slot) in self.ep_of:
-                    continue
-                if tstate[gid, slot] >= ESTABLISHED and \
-                        tstate[gid, slot] != SYN_SENT and \
-                        lport[gid, slot] == port:
-                    nfd = self._alloc_fd(lpid)
-                    self.ep_of[(gid, slot)] = (lpid, nfd)
-                    self.slot_of[(lpid, nfd)] = (gid, slot)
-                    self._pending_comps.append(
-                        (lpid, COMP_ACCEPT, lfd, nfd)
-                    )
-
-        # wire pairing: match endpoints by the (host, port) 4-tuple
-        for key in [k for k in self.ep_of if k not in self.wire]:
-            gid, slot = key
-            peer = (int(phost[gid, slot]), -1)
-            if peer[0] < 0:
+        # 2. new established connections we don't know -> accepted
+        # children (their local port is a listen port; driver-owned
+        # connect slots are marked known at translate time)
+        for gid, slot in zip(*np.nonzero((tstate >= ESTABLISHED)
+                                         & ~self._known)):
+            gid, slot = int(gid), int(slot)
+            lp = self.listen_ep.get((gid, int(lport[gid, slot])))
+            if lp is None:
                 continue
-            pg = peer[0]
-            for pslot in range(tstate.shape[1]):
-                if (pg, pslot) not in self.ep_of:
-                    continue
-                if (
-                    lport[pg, pslot] == pport[gid, slot]
-                    and phost[pg, pslot] == gid
-                    and pport[pg, pslot] == lport[gid, slot]
-                ):
-                    self.wire[key] = (pg, pslot)
-                    self.wire[(pg, pslot)] = key
-                    break
+            lpid, lfd = lp
+            nfd = self._alloc_fd(lpid)
+            self._register_ep(gid, slot, lpid, nfd, driver_owned=False)
+            self._wire_try_pair(gid, slot, int(lport[gid, slot]),
+                                int(phost[gid, slot]),
+                                int(pport[gid, slot]))
+            self._pending_comps.append((lpid, COMP_ACCEPT, lfd, nfd))
 
-        # delivered bytes + FIN propagation
-        for key, (pid, fd) in self.ep_of.items():
-            gid, slot = key
-            d = int(rx[gid, slot] - self._prev_rx[gid, slot])
-            if d > 0:
-                self.undelivered[key] = self.undelivered.get(key, 0) + d
-            if self.undelivered.get(key) and key in self.wire:
+        # 3. delivered bytes + FIN propagation, changed endpoints only
+        fresh_fin = fin & ~self._prev_fin
+        for gid, slot in zip(*np.nonzero(
+            self._known & ((self._undeliv > 0) | fresh_fin)
+        )):
+            key = (int(gid), int(slot))
+            pid, fd = self.ep_of[key]
+            owed = int(self._undeliv[key])
+            if owed and key in self.wire:
                 src = self.wire[key]
                 if src in self.ep_of:
                     spid, sfd = self.ep_of[src]
-                    moved = self.rt.wire_deliver(
-                        spid, sfd, pid, fd, self.undelivered[key]
-                    )
+                    moved = self.rt.wire_deliver(spid, sfd, pid, fd, owed)
                     if moved > 0:
-                        self.undelivered[key] -= moved
-            if fin[gid, slot] and not self._prev_fin[gid, slot]:
-                if not self.undelivered.get(key):
+                        self._undeliv[key] -= moved
+            if fresh_fin[key]:
+                if not self._undeliv[key]:
                     self.rt.wire_fin(pid, fd)
                 else:
                     # bytes still owed; FIN re-checked next window
-                    fin[gid, slot] = False
+                    fin[key] = False
 
-        self._prev_rx = rx
         self._prev_fin = fin
+        self._prev_gen = cgen.copy()
 
     # ---------------------------------------------------------------- run
     def run(self, stop_s: float | None = None):
@@ -396,6 +508,12 @@ class ProcessTier:
                 f"{self.sim.engine.cfg.capacity}); native processes may "
                 "have observed a corrupted simulation — rerun with a "
                 "larger capacity"
+            )
+        if self.lost_stream_bytes and self.strict_overflow:
+            raise RuntimeError(
+                f"{self.lost_stream_bytes} delivered bytes could not be "
+                "flushed to their endpoint before its slot turned over — "
+                "a native process observed a truncated stream"
             )
         return st
 

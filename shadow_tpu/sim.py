@@ -388,13 +388,39 @@ def build_simulation(
     rx_queue: str = "codel",
     qdisc: str = "fifo",
     interface_buffer: int = 1_024_000,
+    tcp_child_slot_limit: int | None = None,
+    locality: bool = False,
 ) -> Simulation:
-    """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts."""
+    """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
+
+    `locality=True` (sharded runs only) reorders hosts at build time so
+    config-visible traffic partners share a shard, cutting cross-shard
+    packet traffic (the static replacement for the reference's random
+    host->thread shuffle + work stealing, scheduler.c:440-534,
+    scheduler_policy_host_steal.c). Host gids and the `names` order then
+    follow the locality layout, so single-vs-sharded comparisons must
+    match hosts by NAME, not position.
+    """
     if registry is None:
         registry = default_registry()
     topo = Topology.from_graphml(cfg.topology_source())
     hosts = expand_hosts(cfg)
     n_hosts = len(hosts)
+    if locality and (mesh is None or int(mesh.devices.size) <= 1):
+        # semantics-bearing options act or fail loudly (the repo-wide
+        # config principle): locality without a multi-shard mesh would
+        # silently change nothing
+        raise ValueError("locality=True requires a multi-device mesh")
+    if locality and mesh is not None and int(mesh.devices.size) > 1:
+        from shadow_tpu.parallel.partition import (
+            apply_order,
+            locality_order,
+            traffic_edges_from_config,
+        )
+
+        edges = traffic_edges_from_config(hosts)
+        perm = locality_order(n_hosts, edges, int(mesh.devices.size))
+        hosts = apply_order(hosts, perm)
 
     # -- attachment + DNS (master.c:307-345 registerHosts -> topology_attach,
     # dns_register)
@@ -508,7 +534,8 @@ def build_simulation(
         raise ValueError(f"unknown qdisc {qdisc!r}")
     tcp_kw = dict(tx_burst=1, inline_budget=1) if qdisc == "rr" else {}
     tcp = (
-        TCP(auto_close=False, cc=tcp_cc, in_order=tcp_in_order, **tcp_kw)
+        TCP(auto_close=False, cc=tcp_cc, in_order=tcp_in_order,
+            child_slot_limit=tcp_child_slot_limit, **tcp_kw)
         if model.needs_tcp else None
     )
     stack = Stack(bootstrap_end=bootstrap_end, tcp=tcp, rx_queue=rx_queue)

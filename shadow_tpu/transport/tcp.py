@@ -547,7 +547,8 @@ class TCP:
 
     def __init__(self, tx_burst: int = 4, inline_budget: int = 2,
                  auto_close: bool = True, cc="reno", delack: bool = True,
-                 in_order: bool = False, autotune: bool = True):
+                 in_order: bool = False, autotune: bool = True,
+                 child_slot_limit: int | None = None):
         self.tx_burst = tx_burst
         self.inline_budget = inline_budget
         self.auto_close = auto_close
@@ -555,6 +556,11 @@ class TCP:
         self.delack = delack
         self.in_order = in_order
         self.autotune = autotune
+        # passive-open children only allocate slots < limit, reserving
+        # the top of the table for driver/app-owned sockets (the process
+        # tier's split — without it a recycled driver slot could be
+        # claimed by an inbound SYN while the driver still holds it)
+        self.child_slot_limit = child_slot_limit
 
     def min_max_emit(self, app_rows: int = 1) -> int:
         """Smallest EngineConfig.max_emit that fits this TCP's handlers.
@@ -777,8 +783,13 @@ class TCP:
         # tcp.c:91-113); SYN at SYN_RCVD = dup -> re-SYN-ACK
         at_listen = syn_only & (row.state == LISTEN)
         dup_syn = syn_only & (row.state == SYN_RCVD)
-        free_slot = jnp.argmax(sockets.proto == PROTO_NONE).astype(_I32)
-        do_open = at_listen & (sockets.proto[free_slot] == PROTO_NONE)
+        child_free = sockets.proto == PROTO_NONE
+        if self.child_slot_limit is not None:
+            child_free = child_free & (
+                jnp.arange(child_free.shape[0]) < self.child_slot_limit
+            )
+        free_slot = jnp.argmax(child_free).astype(_I32)
+        do_open = at_listen & child_free[free_slot]
         child = jnp.where(do_open, free_slot, c)
         child_old = _row(net.tcb, child)
         child_row = _fresh_row_like(child_old)

@@ -89,5 +89,19 @@ def test_batched_drain_bit_identical_to_sequential():
     a = jax.jit(eng_b.run)(init_b(), jnp.int64(3 * SECOND))
     b = jax.jit(eng_s.run)(init_s(), jnp.int64(3 * SECOND))
     assert int(a.stats.n_executed.sum()) > 1000
-    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+    # scheduler self-profiling counters legitimately differ between the
+    # two drain strategies (that is what they measure); simulation state
+    # must not
+    import dataclasses
+
+    strip = lambda st: dataclasses.replace(
+        st,
+        stats=dataclasses.replace(
+            st.stats,
+            n_sweeps=jnp.zeros((), jnp.int64),
+            n_inner_steps=jnp.zeros((), jnp.int64),
+            n_xchg_rounds=jnp.zeros((), jnp.int64),
+        ),
+    )
+    for x, y in zip(jax.tree.leaves(strip(a)), jax.tree.leaves(strip(b))):
         assert np.array_equal(np.asarray(x), np.asarray(y))

@@ -1,0 +1,103 @@
+"""Locality-aware host partitioning (the static replacement for the
+reference's random host shuffle + work stealing, scheduler.c:440-534).
+
+The measured contract (VERDICT r02 item 6): on the TGen pair config,
+locality ordering drops cross-shard packet count by more than 2x vs
+naive config order, with identical per-host results (matched by name).
+"""
+
+import textwrap
+
+import jax
+
+from shadow_tpu.config import expand_hosts, parse_config
+from shadow_tpu.parallel.mesh import make_mesh
+from shadow_tpu.parallel.partition import (
+    locality_order,
+    traffic_edges_from_config,
+)
+from shadow_tpu.sim import build_simulation
+
+TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d3">25.0</data>
+      <data key="d4">0.0</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+
+def pair_config(n_pairs: int) -> str:
+    """Interleave servers and clients so NAIVE block order splits every
+    pair across shard boundaries at most; client i talks only to server
+    i (the dryrun TGen shape)."""
+    hosts = []
+    for i in range(n_pairs):
+        hosts.append(
+            f'<host id="server{i}"><process plugin="tgen" starttime="1" '
+            f'arguments="server port=8888"/></host>'
+        )
+    for i in range(n_pairs):
+        hosts.append(
+            f'<host id="client{i}"><process plugin="tgen" starttime="2" '
+            f'arguments="peers=server{i}:8888 sendsize=4KiB '
+            f'recvsize=16KiB count=2"/></host>'
+        )
+    return textwrap.dedent(f"""\
+    <shadow stoptime="15">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="tgen" path="tgen"/>
+      {''.join(hosts)}
+    </shadow>""")
+
+
+def test_edges_and_order_group_pairs():
+    cfg = parse_config(pair_config(8))
+    hosts = expand_hosts(cfg)
+    edges = traffic_edges_from_config(hosts)
+    # every client names its server exactly once -> 8 edges
+    assert len(edges) == 8
+    perm = locality_order(16, edges, 4)
+    # each shard of 4 must hold its pairs together: position blocks of 4
+    for s in range(4):
+        block = set(perm[4 * s:4 * (s + 1)])
+        for g in list(block):
+            peer = [b for a, b, _ in edges if a == g] + [
+                a for a, b, _ in edges if b == g
+            ]
+            assert all(p in block for p in peer)
+
+
+def test_locality_halves_cross_shard_packets():
+    mesh = make_mesh(8)
+    cfg_text = pair_config(16)  # 32 hosts, 4 per shard
+
+    crosses, totals = [], {}
+    for locality in (False, True):
+        sim = build_simulation(
+            parse_config(cfg_text), seed=5, mesh=mesh, locality=locality
+        )
+        st = sim.run()
+        cross = int(jax.device_get(st.stats.n_cross_shard.sum()))
+        crosses.append(cross)
+        # per-host results keyed by NAME (locality permutes gids)
+        rx = jax.device_get(st.hosts.net.sockets.rx_bytes.sum(axis=1))
+        totals[locality] = {
+            name: int(rx[g]) for g, name in enumerate(sim.names)
+        }
+    naive, local = crosses
+    print(f"cross-shard packets: naive={naive} locality={local}")
+    # interleaved pairs straddle shards under naive order; locality puts
+    # every pair on one shard, so cross-shard traffic collapses
+    assert local * 2 < naive, (naive, local)
+    # identical simulation results, host-by-host (matched by name)
+    assert totals[False] == totals[True]
