@@ -936,6 +936,20 @@ int shim_wire_fin(void* vrt, int pid, int fd) {
     return 0;
 }
 
+/* Forcibly stop a virtual process (the <process stoptime> contract:
+ * the reference stops the plugin and lets the kernel-side socket
+ * teardown continue, process.c process_stop). The green thread never
+ * resumes; its stack is reclaimed at shim_free. */
+int shim_kill(void* vrt, int pid, int exit_code) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (pid < 0 || pid >= static_cast<int>(rt->procs.size())) return -1;
+    Proc* p = rt->procs[pid];
+    if (p->done) return 0;
+    p->done = true;
+    p->exit_code = exit_code;
+    return 0;
+}
+
 /* -1 = running/blocked, otherwise the plugin's exit code. */
 int shim_proc_exit_code(void* vrt, int pid, int* done) {
     Runtime* rt = static_cast<Runtime*>(vrt);

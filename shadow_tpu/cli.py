@@ -353,6 +353,9 @@ def main(argv=None) -> int:
         "sim_s_per_wall_s": round(stop_s / max(wall, 1e-9), 3),
         "net_dropped": int(jax.device_get(stats.n_net_dropped.sum())),
         "queue_drops": int(jax.device_get(st.queues.drops.sum())),
+        # scheduler self-profiling (scheduler.c:266-271 analog)
+        "sweeps": int(jax.device_get(stats.n_sweeps)),
+        "cross_shard_packets": int(jax.device_get(stats.n_cross_shard)),
         "rx_bytes": int(
             jax.device_get(st.hosts.net.sockets.rx_bytes.sum())
         ),
@@ -369,6 +372,12 @@ def main(argv=None) -> int:
             )
         },
     }
+    if drain is not None:
+        # packet-lifecycle class counts from the capture rings (the
+        # PDS_* stage tallies of packet.h:20-40)
+        summary["packet_stages"] = {
+            k: v for k, v in drain.stage_counts.items() if v
+        }
     print(json.dumps(summary))
     return 0
 

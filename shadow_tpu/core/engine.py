@@ -221,13 +221,17 @@ class Engine:
 
     def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network,
                  cpu_cost=None, batch_handler=None):
-        """`cpu_cost`: optional i64[n_hosts * n_shards] per-event
-        virtual-CPU nanoseconds, indexed by GLOBAL host id (the
-        reference's per-host CPU model delays event execution while the
-        virtual CPU is busy — cpu.c:56-107, event.c:75-84). Global
-        indexing lets one engine closure serve every shard: each window
-        gathers its own hosts' costs by gid. None or zeros disables the
-        model with no overhead in results.
+        """`cpu_cost`: optional per-event virtual-CPU nanoseconds, indexed
+        by GLOBAL host id (the reference's per-host CPU model delays
+        event execution while the virtual CPU is busy — cpu.c:56-107,
+        event.c:75-84). Two shapes:
+          i64[H_global]          — uniform cost per event, or
+          i64[H_global, n_kinds] — per-KIND cost (the analog of the
+        reference charging each task its measured execution time rather
+        than a flat constant). Global indexing lets one engine closure
+        serve every shard: each window gathers its own hosts' costs by
+        gid. None or zeros disables the model with no overhead in
+        results.
 
         `batch_handler`: optional commutative fast path. When set, the
         window drain executes each host's whole below-barrier frontier in
@@ -239,28 +243,32 @@ class Engine:
         (b) handlers never emit local events below the window barrier —
         both hold for PHOLD-style models. Per-position RNG keys derive
         from (gid, exec_cnt + position), so results remain deterministic
-        and sharding-independent. Incompatible with the CPU model (which
-        is inherently sequential per host)."""
+        and sharding-independent.
+
+        The CPU model composes with the batched drain at whole-frontier
+        granularity: a host whose virtual CPU is busy past the barrier
+        runs nothing this window, and each executed frontier advances
+        cpu_free by the SUM of its events' costs — the batched analog of
+        the reference's delay rounding (cpu.c:85-95 rounds accumulated
+        delay to a precision grid rather than modeling each instant)."""
         self.cfg = cfg
         self.handlers = tuple(handlers)
         self.network = network
         self.batch_handler = batch_handler
         self._base_key = srng.root_key(cfg.seed)
+        hg = cfg.n_hosts * cfg.n_shards
+        nk = len(self.handlers)
         if cpu_cost is None:
-            cpu_cost = jnp.zeros((cfg.n_hosts * cfg.n_shards,), jnp.int64)
-        elif batch_handler is not None and jnp.any(
-            jnp.asarray(cpu_cost) != 0
-        ):
+            cpu_cost = jnp.zeros((hg, nk), jnp.int64)
+        cpu_cost = jnp.asarray(cpu_cost, jnp.int64)
+        if cpu_cost.shape not in ((hg,), (hg, nk)):
             raise ValueError(
-                "batch_handler (commutative drain) cannot be combined "
-                "with the per-host CPU model"
+                f"cpu_cost must be [H_global]={hg} or [H_global, "
+                f"n_kinds]=({hg}, {nk}), got shape {cpu_cost.shape}"
             )
-        self.cpu_cost = jnp.asarray(cpu_cost, jnp.int64)
-        if self.cpu_cost.shape != (cfg.n_hosts * cfg.n_shards,):
-            raise ValueError(
-                f"cpu_cost must cover all {cfg.n_hosts * cfg.n_shards} "
-                f"global hosts, got shape {self.cpu_cost.shape}"
-            )
+        if cpu_cost.ndim == 1:
+            cpu_cost = jnp.broadcast_to(cpu_cost[:, None], (hg, nk))
+        self.cpu_cost = cpu_cost
         # jitter rolls cost an extra uniform per emit row; skip them
         # entirely for jitter-free networks
         self._use_jitter = bool(getattr(network, "has_jitter", False))
@@ -516,15 +524,21 @@ class Engine:
         h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
         b = max(1, min(cfg.drain_batch, c))
         gids = host0 + jnp.arange(h, dtype=jnp.int32)
+        cpu_cost = self.cpu_cost[gids]  # [H, NK]
 
         def outer_cond(carry):
-            q = carry[0]
-            return self._gany(jnp.any(q.min_time() < window_end))
+            q, cpu_free = carry[0], carry[5]
+            nxt = jnp.maximum(q.min_time(), cpu_free)
+            return self._gany(jnp.any(nxt < window_end))
 
         def outer_body(carry):
-            q, hosts, src_seq, exec_cnt, stats = carry
+            q, hosts, src_seq, exec_cnt, stats, cpu_free = carry
             bt = q.time[:, :b]
-            bvalid = bt < window_end  # a prefix: rows are key-sorted
+            # a host whose virtual CPU is busy past the barrier runs
+            # nothing this window (whole-frontier granularity)
+            bvalid = (bt < window_end) & (
+                cpu_free[:, None] < window_end
+            )  # a prefix: rows are key-sorted
             evs = Events(
                 time=jnp.where(bvalid, bt, TIME_INVALID),
                 dst=jnp.broadcast_to(gids[:, None], (h, b)),
@@ -583,6 +597,20 @@ class Engine:
                     axis=1,
                 ),
             )
+            # virtual-CPU charge: the frontier's summed per-kind costs
+            # advance this host's cpu_free past its last executed event
+            kidx = jnp.clip(evs.kind, 0, cpu_cost.shape[1] - 1)
+            ev_cost = jnp.take_along_axis(cpu_cost, kidx, axis=1)
+            total_cost = jnp.sum(
+                jnp.where(bvalid, ev_cost, 0), axis=1
+            )
+            t_last = jnp.max(jnp.where(bvalid, bt, 0), axis=1)
+            cpu_free = jnp.where(
+                total_cost > 0,
+                jnp.maximum(cpu_free, t_last) + total_cost,
+                cpu_free,
+            )
+
             cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < n_exec[:, None]
             q = dataclasses.replace(
                 q, time=jnp.where(cleared, TIME_INVALID, q.time)
@@ -596,10 +624,11 @@ class Engine:
                 n_xchg_rounds=stats2.n_xchg_rounds + xr,
                 n_cross_shard=stats2.n_cross_shard + nc,
             )
-            return (q, hosts, src_seq, exec_cnt, stats2)
+            return (q, hosts, src_seq, exec_cnt, stats2, cpu_free)
 
-        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats)
-        q, hosts, src_seq, exec_cnt, stats = jax.lax.while_loop(
+        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats,
+                 st.cpu_free)
+        q, hosts, src_seq, exec_cnt, stats, cpu_free = jax.lax.while_loop(
             outer_cond, outer_body, carry
         )
         return dataclasses.replace(
@@ -609,6 +638,7 @@ class Engine:
             src_seq=src_seq,
             exec_cnt=exec_cnt,
             stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
+            cpu_free=cpu_free,
         )
 
     # -- window = drain all events below the barrier ------------------------
@@ -619,7 +649,7 @@ class Engine:
         h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
         b = max(1, min(cfg.drain_batch, c))
         gids = host0 + jnp.arange(h, dtype=jnp.int32)
-        cpu_cost = self.cpu_cost[gids]  # this shard's per-host costs
+        cpu_cost = self.cpu_cost[gids]  # [H, NK] this shard's costs
         i64max = jnp.iinfo(jnp.int64).max
 
         def outer_cond(carry):
@@ -678,8 +708,12 @@ class Engine:
                  local_below) = self._execute_step(
                     hosts, src_seq, exec_cnt, stats, ev, active, window_end, gids
                 )
+                kidx = jnp.clip(ev.kind, 0, cpu_cost.shape[1] - 1)
+                ev_cost = jnp.take_along_axis(
+                    cpu_cost, kidx[:, None], axis=1
+                )[:, 0]
                 cpu_free = jnp.where(
-                    active & (cpu_cost > 0), eff_t + cpu_cost,
+                    active & (ev_cost > 0), eff_t + ev_cost,
                     cpu_free,
                 )
                 upd = lambda buf, x: jax.lax.dynamic_update_index_in_dim(buf, x, bi, 0)

@@ -53,6 +53,10 @@ class ProcTierModel:
     name = "shim"
     needs_tcp = True
     n_kinds = 1
+    # the driver stops individual green threads at their stoptime
+    # (process.c process_stop semantics); device-side host muting must
+    # not also fire — it would freeze the whole host's TCP machinery
+    owns_process_lifecycle = True
 
     def __init__(self):
         self._stack = None

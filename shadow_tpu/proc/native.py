@@ -171,6 +171,9 @@ class ShimRuntime:
         lib.shim_dns_add.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
         ]
+        lib.shim_kill.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ]
         self._lib = lib
         self._rt = lib.shim_init()
         self._req_buf = (ShimReq * max_reqs)()
@@ -219,6 +222,10 @@ class ShimRuntime:
         """Push one name -> virtual-IPv4 (host order) mapping for the
         interposer's getaddrinfo (dns.c registry semantics)."""
         self._lib.shim_dns_add(self._rt, name.encode(), ip)
+
+    def kill(self, pid: int, exit_code: int = 0) -> None:
+        """Stop a virtual process (per-process stoptime semantics)."""
+        self._lib.shim_kill(self._rt, pid, exit_code)
 
     def exit_code(self, pid: int) -> int | None:
         done = ctypes.c_int(0)

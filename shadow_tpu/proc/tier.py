@@ -141,7 +141,13 @@ class ProcessTier:
         self._pending_comps: list[tuple] = []
         self._push_jit = jax.jit(queue_push, static_argnames=())
 
+        # per-process stoptime heap ((stop_ns, pid); the reference stops
+        # each plugin individually, configuration.h:38-102 + process_stop)
+        self._stops: list[tuple[int, int]] = []
+        # locality may have renumbered gids; map hosts by NAME
+        gid_of = {name: g for g, name in enumerate(self.sim.names)}
         for h in expand_hosts(cfg):
+            gid = gid_of.get(h.name, h.gid)
             for p in h.spec.processes:
                 spec = cfg.plugin_by_id(p.plugin)
                 path = resolve_path(spec.path, cfg.base_dir) if spec else p.plugin
@@ -153,9 +159,13 @@ class ProcessTier:
                         "native plugins with modeled ones yet"
                     )
                 argv = [os.path.basename(path)] + shlex.split(p.arguments)
-                pid = self.rt.spawn(h.gid, path, argv)
-                self.pid_host[pid] = h.gid
+                pid = self.rt.spawn(gid, path, argv)
+                self.pid_host[pid] = gid
                 heapq.heappush(self._starts, (int(p.starttime * SECOND), pid))
+                if p.stoptime:
+                    heapq.heappush(
+                        self._stops, (int(p.stoptime * SECOND), pid)
+                    )
 
         h_n = len(self.sim.names)
         self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
@@ -461,6 +471,30 @@ class ProcessTier:
             while self._starts and self._starts[0][0] <= now:
                 _, pid = heapq.heappop(self._starts)
                 self.rt.start(pid)
+            stop_rows = []
+            while self._stops and self._stops[0][0] <= now:
+                _, pid = heapq.heappop(self._stops)
+                if pid in self.exit_codes:
+                    continue  # already exited on its own
+                self.rt.kill(pid, 0)
+                self.exit_codes[pid] = 0
+                # retire the dead process's timer arms and sleeps so
+                # they stop bounding window sizes and pumping
+                # completions at nobody (the stale-gen path drops the
+                # heap entries lazily)
+                for key in [k for k in self._timer_gen if k[0] == pid]:
+                    self._timer_gen[key] += 1
+                self._wakes = [w for w in self._wakes if w[1] != pid]
+                heapq.heapify(self._wakes)
+                # kernel-side teardown continues for the dead process's
+                # sockets (the reference's process_stop leaves the TCP
+                # close handshakes to the host model): FIN every driver
+                # endpoint the process still holds
+                for (pfd_pid, fd), (gid, slot) in list(self.slot_of.items()):
+                    if pfd_pid == pid:
+                        stop_rows.append((gid, [CMD_CLOSE, slot]))
+            if stop_rows:
+                st = self._inject(st, stop_rows, now)
             while self._wakes and self._wakes[0][0] <= now:
                 _, pid, gen = heapq.heappop(self._wakes)
                 comps.append((pid, COMP_WAKE, -1, gen))
@@ -488,6 +522,8 @@ class ProcessTier:
             bound = stop_ns
             if self._starts:
                 bound = min(bound, max(self._starts[0][0], now + 1))
+            if self._stops:
+                bound = min(bound, max(self._stops[0][0], now + 1))
             if self._wakes:
                 bound = min(bound, max(self._wakes[0][0], now + 1))
             # retire re-armed/disarmed timer entries so a dead arm stops

@@ -474,16 +474,19 @@ def build_simulation(
             if s.pcapdir:
                 pcap_dirs.add(s.pcapdir)
         stops = {p.stoptime for p in s.processes if p.stoptime}
-        if stops:
+        if stops and not getattr(app_model, "owns_process_lifecycle", False):
             if len(s.processes) > 1 and (
                 len(stops) > 1 or len(stops) < len(s.processes)
             ):
-                # app-handler muting is per host; a partial stop would
-                # silently kill the host's other processes too
+                # jitted app models collapse a host's processes into one
+                # state row, so app-handler muting is per host; a partial
+                # stop would silently kill the host's other processes
+                # too. The process tier owns true per-process lifecycle
+                # (each process is its own green thread) and opts out.
                 raise ValueError(
                     f"host {h.name!r}: all processes on a host must share "
-                    "one stoptime (per-process stop is not implemented "
-                    "for multi-process hosts)"
+                    "one stoptime (per-process stop needs the real-binary "
+                    "tier, whose processes are individual green threads)"
                 )
             proc_stop[h.gid] = int(stops.pop() * SECOND)
 

@@ -57,6 +57,7 @@ F_SYN = 1 << 2
 F_ACK = 1 << 3
 F_FIN = 1 << 4
 F_RST = 1 << 5
+F_RETX = 1 << 6  # sender-stamped retransmission (PDS_RETRANSMITTED)
 
 KIND_PKT_ARRIVE = 0
 KIND_PKT_RX = 1
@@ -225,8 +226,26 @@ class Stack:
         # the NIC only (the reference's tracker splits payload vs header
         # bytes the same way, tracker.c:433-479)
         sockets = net.sockets.add_tx(jnp.where(mask, slot, -1), nbytes)
+        cap = net.cap
+        if cap is not None:
+            # tx-side lifecycle record on the SENDER's ring (the
+            # reference captures both directions at the NIC,
+            # network_interface.c:337-373)
+            from shadow_tpu.utils.pcap import STG_SENT
+
+            cap2 = cap.append(
+                now, jnp.asarray(-1, jnp.int32), dst_host, sport, dst_port,
+                jnp.asarray(PROTO_UDP, jnp.int32),
+                jnp.asarray(nbytes, jnp.int32), 0, 0, STG_SENT,
+            )
+            cap = jax.tree.map(
+                lambda n, o: jnp.where(mask, n, o), cap2, cap
+            )
         hs = dataclasses.replace(
-            hs, net=dataclasses.replace(net, nic_tx=nic_tx, sockets=sockets)
+            hs,
+            net=dataclasses.replace(
+                net, nic_tx=nic_tx, sockets=sockets, cap=cap
+            ),
         )
         args = Pkt.encode_args(PROTO_UDP, sport, dst_port, length=nbytes, aux=aux)
         em = Emit.single(
@@ -294,21 +313,30 @@ class Stack:
             )
             cap = net.cap
             if cap is not None:
-                # packet-lifecycle capture incl. the queue verdict (richer
-                # than the reference's capture, which runs before the
-                # receive queue: network_interface.c:337-373)
+                # packet-lifecycle capture: a STAGE bitmask per record
+                # reconstructs the packet's path (the reference appends
+                # PDS_* stage flags hop by hop, packet.h:20-40; its pcap
+                # capture runs before the receive queue and cannot see
+                # drops, network_interface.c:337-373)
                 from shadow_tpu.utils.pcap import (
-                    V_AQM_DROP, V_DELIVERED, V_TAIL_DROP,
+                    STG_AQM_DROP, STG_ARRIVED, STG_DELIVERED, STG_QUEUED,
+                    STG_RETX, STG_TAIL_DROP,
                 )
 
-                verdict = jnp.where(
-                    tail_drop, V_TAIL_DROP,
-                    jnp.where(drop, V_AQM_DROP, V_DELIVERED),
+                stages = (
+                    STG_ARRIVED
+                    | jnp.where(sojourn > 0, STG_QUEUED, 0)
+                    | jnp.where(tail_drop, STG_TAIL_DROP, 0)
+                    | jnp.where(drop & ~tail_drop, STG_AQM_DROP, 0)
+                    | jnp.where(drop, 0, STG_DELIVERED)
+                    | jnp.where(
+                        (ev.args[A_META] & F_RETX) != 0, STG_RETX, 0
+                    )
                 )
                 cap = cap.append(
                     now, ev.src, ev.dst, ev.args[A_SPORT], ev.args[A_DPORT],
                     ev.args[A_META], ev.args[A_LEN], ev.args[A_SEQ],
-                    ev.args[A_ACK], verdict,
+                    ev.args[A_ACK], stages,
                 )
             hs = dataclasses.replace(
                 hs,

@@ -78,6 +78,7 @@ from shadow_tpu.core.timebase import MILLISECOND, SECOND
 from shadow_tpu.host.nic import HEADER_TCP, MTU
 from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP, PROTO_UDP
 from shadow_tpu.transport.stack import (
+    F_RETX,
     A_LEN,
     F_ACK,
     F_FIN,
@@ -572,14 +573,17 @@ class TCP:
 
     # ------------------------------------------------------------ helpers
     def _seg_row(self, nic_tx, row, now, dst_host, sport, dport, s, is_fin,
-                 ok, unlimited):
+                 ok, unlimited, is_retx=False):
         """One data/FIN segment through the tx NIC; returns
-        (nic_tx', emit_row)."""
+        (nic_tx', emit_row). `is_retx` stamps F_RETX into the header so
+        receivers/captures can classify the segment (the PDS_RETRANSMITTED
+        stage of the reference's packet lifecycle, packet.h:20-40)."""
         length = jnp.where(is_fin, 0, _seg_len(row.snd_buf, s))
         wire = length + HEADER_TCP
         nic2, _start, fin_t = nic_tx.admit(now, wire, unlimited)
         nic_tx = jax.tree.map(lambda n, o: jnp.where(ok, n, o), nic2, nic_tx)
-        flags = F_ACK | jnp.where(is_fin, F_FIN, 0)
+        flags = (F_ACK | jnp.where(is_fin, F_FIN, 0)
+                 | jnp.where(jnp.asarray(is_retx), F_RETX, 0))
         args = _pkt_args(
             sport, dport, seq=s, ack=row.rcv_nxt, length=length,
             wnd=row.rwnd, aux=_ts_us(now), flags=flags, sack=row.ooo[0],
@@ -1126,7 +1130,7 @@ class TCP:
         retx_fin = row.fin_pending & (row.snd_una == n_segs)
         nic_tx, retx_row = self._seg_row(
             nic_tx, row, now, peer_h, sport, peer_p, row.snd_una, retx_fin,
-            retx & (row.snd_una < row.snd_nxt), unlimited,
+            retx & (row.snd_una < row.snd_nxt), unlimited, is_retx=True,
         )
 
         # -- inline new-data tx (ACK-clocked)
@@ -1331,7 +1335,7 @@ class TCP:
         retx_fin = row.fin_pending & (row.snd_una == n_segs)
         nic_tx, data_row = self._seg_row(
             net.nic_tx, row, now, peer_h, sport, peer_p, row.snd_una,
-            retx_fin, is_data_rtx, unlimited,
+            retx_fin, is_data_rtx, unlimited, is_retx=True,
         )
         hs_flags = jnp.where(is_syn_rtx, F_SYN, F_SYN | F_ACK)
         nic2, _s, fin_t = nic_tx.admit(now, HEADER_TCP, unlimited)

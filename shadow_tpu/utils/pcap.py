@@ -42,9 +42,32 @@ M_SEQ = 6
 M_ACK = 7
 N_META = 8
 
-V_DELIVERED = 0
-V_AQM_DROP = 1
-V_TAIL_DROP = 2
+# Packet-lifecycle STAGE bitmask (the reference appends 21 PDS_* stage
+# flags to every packet as it moves, packet.h:20-40,
+# packet_addDeliveryStatus; this is the observable-stage subset of that
+# lifecycle for the two-hop device pipeline). Rides the capture record's
+# verdict byte, so a packet's path is reconstructible from its capture
+# row — and from any standard pcap tool via the IP TOS field.
+STG_ARRIVED = 1 << 0     # reached the destination host edge (PDS_RCV_INTERFACE_*)
+STG_QUEUED = 1 << 1      # waited in the rx queue (standing sojourn > 0)
+STG_DELIVERED = 1 << 2   # handed to the socket demux (PDS_RCV_SOCKET_*)
+STG_AQM_DROP = 1 << 3    # CoDel control-law drop (PDS_RCV_INTERFACE_DROPPED)
+STG_TAIL_DROP = 1 << 4   # rx-buffer tail drop
+STG_RETX = 1 << 5        # sender stamped this a retransmission
+STG_SENT = 1 << 6        # tx-side record (source host's own ring)
+
+STAGE_NAMES = {
+    STG_ARRIVED: "arrived", STG_QUEUED: "queued",
+    STG_DELIVERED: "delivered", STG_AQM_DROP: "dropped_aqm",
+    STG_TAIL_DROP: "dropped_tail", STG_RETX: "retransmitted",
+    STG_SENT: "sent",
+}
+
+# legacy single-verdict aliases (round-2 records; still what the drop
+# analysis keys on)
+V_DELIVERED = STG_DELIVERED
+V_AQM_DROP = STG_AQM_DROP
+V_TAIL_DROP = STG_TAIL_DROP
 
 
 @jax.tree_util.register_dataclass
@@ -138,9 +161,9 @@ class PcapWriter:
                              8 + length, 0)
         )
         ip_len = 20 + len(l4) + length
-        # the queue verdict rides the IP TOS/DSCP byte (0 = delivered,
-        # 1 = AQM drop, 2 = tail drop) so drop analysis works in any
-        # standard pcap tool via an ip.dsfield filter
+        # the lifecycle STAGE BITMASK (STG_* bits above) rides the IP
+        # TOS/DSCP byte, so stage analysis works in any standard pcap
+        # tool via ip.dsfield bit filters (e.g. delivered = bit 2)
         ip = struct.pack(
             ">BBHHHBBH4s4s", 0x45, verdict & 0xFF, ip_len & 0xFFFF, 0, 0,
             64, 6 if is_tcp else 17, 0, self.ip_lookup(src),
@@ -186,6 +209,10 @@ class CaptureDrain:
             for gid, name in zip(host_ids, names)
         }
         self.last_wr = {gid: 0 for gid in host_ids}
+        # per-lifecycle-stage record counts across all drained rings
+        # (surfaced by the CLI summary; the parse/plot tools read the
+        # same classes from the capture files' TOS byte)
+        self.stage_counts = {name: 0 for name in STAGE_NAMES.values()}
 
     def drain(self, cap: CaptureRing) -> None:
         t = np.asarray(jax.device_get(cap.t))
@@ -202,12 +229,19 @@ class CaptureDrain:
             order = sorted(idx, key=lambda i: int(t[gid, i]))
             for i in order:
                 m = meta[gid, i]
+                stages = (int(m[M_META]) >> 16) & 0xFF
+                for bit, name in STAGE_NAMES.items():
+                    if stages & bit:
+                        self.stage_counts[name] += 1
+                src = int(m[M_SRC])
+                if src < 0:
+                    src = gid  # tx-side record: the ring's own host
                 w.record(
-                    int(t[gid, i]), int(m[M_SRC]), int(m[M_DST]),
+                    int(t[gid, i]), src, int(m[M_DST]),
                     int(m[M_SPORT]), int(m[M_DPORT]),
                     int(m[M_META]) & 0xFFFF, int(m[M_LEN]),
                     int(m[M_SEQ]), int(m[M_ACK]),
-                    verdict=(int(m[M_META]) >> 16) & 0xFF,
+                    verdict=stages,
                 )
             self.last_wr[gid] = new
 

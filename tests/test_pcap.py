@@ -94,6 +94,46 @@ def test_logpcap_produces_capture(tmp_path):
     assert tcp_seen >= 8
 
 
+def test_lifecycle_stages_on_lossy_path(tmp_path):
+    """PDS-stage tracing (packet.h:20-40 analog): on a lossy path the
+    capture classifies arrivals into delivered / retransmitted stages,
+    and every record carries the ARRIVED stage bit in its TOS byte."""
+    from shadow_tpu.utils.pcap import (
+        STG_ARRIVED, STG_DELIVERED, STG_RETX,
+    )
+
+    cfg_text = _cfg(tmp_path).replace(
+        '<edge source="p" target="p"><data key="d3">20.0</data></edge>',
+        '<edge source="p" target="p"><data key="d3">20.0</data>'
+        '<data key="d4">0.2</data></edge>',
+    ).replace(
+        '<key attr.name="latency"',
+        '<key attr.name="packetloss" attr.type="double" for="edge" '
+        'id="d4" /><key attr.name="latency"',
+    ).replace("sendsize=8KiB", "sendsize=64KiB")
+    cfg = parse_config(cfg_text)
+    sim = build_simulation(cfg, seed=11)
+    st = sim.run()
+    drain = CaptureDrain(
+        [sim.names[g] for g in sim.pcap_gids], sim.pcap_gids,
+        str(tmp_path), dns=sim.dns,
+    )
+    drain.drain(st.hosts.net.cap)
+    drain.close()
+    assert drain.stage_counts["arrived"] > 0
+    assert drain.stage_counts["delivered"] > 0
+    # 20% loss on a 64KiB transfer forces retransmissions, and the
+    # sender-stamped F_RETX flag survives into the receiver's capture
+    assert drain.stage_counts["retransmitted"] > 0, drain.stage_counts
+
+    # the stage bitmask rides the IP TOS byte of every record
+    recs = _parse_pcap(tmp_path / "server.pcap")
+    toss = [frame[15] for _t, _u, _i, _o, frame in recs]
+    assert all(t & STG_ARRIVED for t in toss)
+    assert any(t & STG_DELIVERED for t in toss)
+    assert any(t & STG_RETX for t in toss)
+
+
 def test_capture_sees_only_flagged_hosts(tmp_path):
     cfg = parse_config(_cfg(tmp_path))
     sim = build_simulation(cfg, seed=4)

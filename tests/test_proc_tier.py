@@ -178,3 +178,35 @@ def test_echo_pair_over_lossy_path(plugin):
     tier.run()
     assert tier.exit_codes == {0: 0, 1: 0}, (tier.exit_codes, tier.logs)
     tier.close()
+
+
+def test_per_process_stoptime(clock_plugin):
+    """<process stoptime>: each process stops individually — two clocks
+    on ONE host, one stopped at t=3, the other running to completion
+    (the reference's per-process stoptime, configuration.h:38-102; round
+    2 rejected differing stoptimes on multi-process hosts outright)."""
+    from shadow_tpu.proc import ProcessTier
+
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="30">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="shim_clock" path="{clock_plugin}"/>
+      <host id="clocker">
+        <process plugin="shim_clock" starttime="1" stoptime="3"
+          arguments="500 40"/>
+        <process plugin="shim_clock" starttime="1" arguments="500 10"/>
+      </host>
+    </shadow>"""))
+    tier = ProcessTier(cfg, seed=6)
+    tier.run()
+    # pid 1 (no stoptime) ran its 10 ticks to completion
+    assert any("clock done: 10 ticks" in m for _, _, m in tier.logs
+               if _ is not None), tier.logs
+    assert tier.exit_codes.get(1) == 0
+    # pid 0 was stopped at t=3 (~4 ticks of 40) — killed, exit 0 recorded
+    assert tier.exit_codes.get(0) == 0
+    assert not any("clock done: 40 ticks" in m for _, _, m in tier.logs)
+    # no tick message from pid 0 after its stoptime
+    late = [t for t, pid, m in tier.logs if pid == 0 and t > 3_100_000_000]
+    assert not late, late
+    tier.close()
