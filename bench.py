@@ -220,26 +220,36 @@ def run_secondary(flag: str, nominal_timeout: int = 600) -> dict:
     metrics. The subprocess reuses the persistent compilation cache, so a
     warm machine pays seconds, not the cold compile. The timeout is the
     smaller of the nominal value and the remaining bench budget; with
-    under a minute left the stage is skipped outright."""
+    under a minute left the stage is skipped outright.
+
+    One retry on failure: the axon backend intermittently reports
+    'UNAVAILABLE: TPU device error' on heavy fresh compiles — measured
+    to be transient (the identical program passes on re-run from the
+    now-warm cache), so a single retry converts most flakes into
+    numbers."""
     import subprocess
 
-    timeout = min(nominal_timeout, _remaining() - 30)
-    if timeout < 60:
-        print(f"bench: skipping {flag} (budget exhausted)", file=sys.stderr)
-        return {}
-    try:
-        res = subprocess.run(
-            [sys.executable, __file__, flag],
-            capture_output=True, text=True, timeout=timeout,
-        )
-        for line in reversed(res.stdout.strip().splitlines()):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-        err = res.stderr
-    except subprocess.TimeoutExpired:
-        err = f"timed out after {timeout:.0f}s"
+    err = ""
+    for _attempt in range(2):
+        timeout = min(nominal_timeout, _remaining() - 30)
+        if timeout < 60:
+            print(f"bench: skipping {flag} (budget exhausted)",
+                  file=sys.stderr)
+            break  # fall through so a first-attempt error still prints
+        try:
+            res = subprocess.run(
+                [sys.executable, __file__, flag],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            for line in reversed(res.stdout.strip().splitlines()):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+            err = res.stderr
+        except subprocess.TimeoutExpired:
+            err = f"timed out after {timeout:.0f}s"
+            break  # a hang will not improve on retry; save the budget
     if err:
         print(f"bench worker {flag} failed:\n"
               + "\n".join(err.strip().splitlines()[-12:]),
@@ -290,18 +300,25 @@ def main():
     # (verified: the skew/tor workers return results while the parent
     # stays live); on an exclusive-access libtpu runtime the secondaries
     # would degrade to {} — and the primary line still lands, which is
-    # the priority ordering this file exists to guarantee
+    # the priority ordering this file exists to guarantee.
+    # The transient-device-fault retry runs in a SUBPROCESS (a faulted
+    # in-process backend cannot be reinitialized).
     try:
         r = tpu_rate(stop_s)
     except Exception as e:  # noqa: BLE001 — a dead accelerator must
         # still produce the JSON line
-        print(json.dumps({
-            "metric": "phold_events_per_sec", "value": 0.0,
-            "unit": "events/s", "vs_baseline": 0.0,
-            "error": f"primary workload failed: {type(e).__name__}: {e}",
-            "baseline_python_events_per_sec": round(py_rate, 1),
-        }), flush=True)
-        return
+        print(f"bench: primary failed in-process "
+              f"({type(e).__name__}: {e}); retrying in a subprocess",
+              file=sys.stderr)
+        r = run_secondary("--phold-worker", nominal_timeout=900)
+        if not r:
+            print(json.dumps({
+                "metric": "phold_events_per_sec", "value": 0.0,
+                "unit": "events/s", "vs_baseline": 0.0,
+                "error": f"primary workload failed: {type(e).__name__}: {e}",
+                "baseline_python_events_per_sec": round(py_rate, 1),
+            }), flush=True)
+            return
     out = {
         "metric": "phold_events_per_sec",
         "value": round(r["events_per_s"], 1),

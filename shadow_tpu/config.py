@@ -155,9 +155,12 @@ def _get(attrs: dict, *names: str, default=None):
     return default
 
 
-def parse_config(text_or_path: str) -> ShadowConfig:
-    """Parse a shadow.config.xml document (string or file path)."""
-    base_dir = "."
+def parse_config(text_or_path: str, base_dir: str | None = None) -> ShadowConfig:
+    """Parse a shadow.config.xml document (string or file path).
+
+    `base_dir` overrides relative-path resolution for inline text (a
+    path argument derives it from the file's directory)."""
+    base_dir = base_dir or "."
     data = text_or_path
     if "\n" not in data and not data.lstrip().startswith("<"):
         base_dir = os.path.dirname(os.path.abspath(data)) or "."

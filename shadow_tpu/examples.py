@@ -115,3 +115,19 @@ def bitcoin_example(
         + "".join(hosts)
         + "</shadow>"
     )
+
+
+def phold_example(n_hosts: int = 64, msgs_per_host: int = 4,
+                  stoptime: int = 60) -> str:
+    """A PHOLD config (the reference's perf harness as a config-driven
+    sim: src/test/phold/phold.test.shadow.config.xml, quantity=N over a
+    single 50ms PoI)."""
+    return (
+        f'<shadow stoptime="{stoptime}">'
+        f"<topology><![CDATA[{EXAMPLE_TOPOLOGY}]]></topology>"
+        '<plugin id="phold" path="shadow-plugin-test-phold"/>'
+        f'<host id="peer" quantity="{n_hosts}">'
+        f'<process plugin="phold" starttime="1" '
+        f'arguments="load={msgs_per_host}"/>'
+        "</host></shadow>"
+    )

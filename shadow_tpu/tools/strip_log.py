@@ -1,0 +1,63 @@
+"""Normalize simulation logs for determinism diffs.
+
+The reference strips per-run noise (memory addresses, wall-clock run
+timing) from log files so repeated experiments can be compared byte for
+byte (reference: src/tools/strip_log_for_compare.py; the determinism
+tests diff host stdout the same way,
+src/test/determinism/determinism1_compare.cmake). shadow_tpu logs carry
+different noise: wall-clock fields in summary JSON, build/compile
+timings, host hex ids in tracebacks. This tool keeps the
+simulation-determined content only.
+
+    python -m shadow_tpu.tools.strip_log run.log stripped.log
+    diff <(... run1) <(... run2)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+# wall-clock-derived summary fields (everything else in the summary is
+# simulation-determined and must be identical across repeat runs)
+_WALL_KEYS = {
+    "wall_seconds", "build_seconds", "events_per_sec", "sim_s_per_wall_s",
+}
+
+_HEX_ADDR = re.compile(r"0x[0-9a-fA-F]{6,}")
+
+
+def strip_line(line: str) -> str | None:
+    """Normalized line, or None to drop it entirely."""
+    s = line.rstrip("\n")
+    if s.startswith("{") and s.endswith("}"):
+        try:
+            obj = json.loads(s)
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict):
+            for k in _WALL_KEYS:
+                obj.pop(k, None)
+            return json.dumps(obj, sort_keys=True)
+    # progress/timing diagnostics are wall-clock noise
+    if "compile" in s and "second" in s:
+        return None
+    return _HEX_ADDR.sub("0xADDR", s)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 2:
+        print("usage: strip_log <logfile> <outputfile>", file=sys.stderr)
+        return 2
+    with open(argv[0]) as fin, open(argv[1], "w") as fout:
+        for line in fin:
+            out = strip_line(line)
+            if out is not None:
+                fout.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Tooling parity: generate / convert / strip-log (reference
+src/tools/generate_example_config.py, convert_multi_app.py,
+strip_log_for_compare.py)."""
+
+import json
+
+from shadow_tpu.config import expand_hosts, parse_config
+from shadow_tpu.tools.convert_config import convert
+from shadow_tpu.tools.generate_config import main as generate_main
+from shadow_tpu.tools.strip_log import strip_line
+
+
+def test_generate_writes_runnable_configs(tmp_path):
+    for kind in ("tgen", "tor", "bitcoin", "phold"):
+        out = tmp_path / kind
+        assert generate_main([kind, "-o", str(out)]) == 0
+        cfg = parse_config((out / "shadow.config.xml").read_text(),
+                           base_dir=str(out))
+        assert cfg.stoptime > 0
+        assert expand_hosts(cfg)
+    # tgen also ships the traffic-graph files its model parses
+    assert (tmp_path / "tgen" / "tgen.client.graphml.xml").exists()
+
+
+def test_convert_normalizes_legacy_spellings(tmp_path):
+    legacy = """<shadow stoptime="30">
+  <topology><![CDATA[<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d0" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d1" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d2" />
+  <graph edgedefault="undirected">
+    <node id="p"><data key="d1">1024</data><data key="d2">1024</data></node>
+    <edge source="p" target="p"><data key="d0">10.0</data></edge>
+  </graph></graphml>]]></topology>
+  <plugin id="tgen" path="tgen"/>
+  <host id="s" quantity="2" bandwidthup="2048">
+    <application plugin="tgen" time="1" arguments="server port=80"/>
+  </host>
+</shadow>"""
+    converted = convert(legacy)
+    # legacy <application time=...> became canonical <process starttime=...>
+    assert "<process plugin" in converted
+    assert 'starttime="1"' in converted
+    # the round trip parses identically
+    a = parse_config(legacy)
+    b = parse_config(converted)
+    assert [h.name for h in expand_hosts(a)] == [
+        h.name for h in expand_hosts(b)
+    ]
+    assert a.stoptime == b.stoptime
+    assert [h.spec.bandwidthup for h in expand_hosts(a)] == [
+        h.spec.bandwidthup for h in expand_hosts(b)
+    ]
+
+
+def test_strip_log_removes_wall_clock_noise():
+    summary = {"hosts": 2, "events": 123, "wall_seconds": 4.56,
+               "events_per_sec": 27.0, "sim_s_per_wall_s": 1.2}
+    out = strip_line(json.dumps(summary))
+    parsed = json.loads(out)
+    assert parsed == {"hosts": 2, "events": 123}
+    # two runs differing only in wall time strip identically
+    summary2 = dict(summary, wall_seconds=9.87, events_per_sec=13.0)
+    assert strip_line(json.dumps(summary2)) == out
+    # addresses are normalized, sim content kept
+    assert strip_line("obj at 0xdeadbeef42 done") == "obj at 0xADDR done"
+
+
+def test_convert_inlines_path_topology_and_keeps_diagnostics(tmp_path):
+    topo = ('<graphml xmlns="http://graphml.graphdrawing.org/xmlns">'
+            '<key attr.name="latency" attr.type="double" for="edge" id="d0"/>'
+            '<key attr.name="bandwidthup" attr.type="int" for="node" id="d1"/>'
+            '<key attr.name="bandwidthdown" attr.type="int" for="node" id="d2"/>'
+            '<graph edgedefault="undirected">'
+            '<node id="p"><data key="d1">1024</data><data key="d2">1024</data></node>'
+            '<edge source="p" target="p"><data key="d0">10.0</data></edge>'
+            "</graph></graphml>")
+    (tmp_path / "net.graphml").write_text(topo)
+    legacy = """<shadow stoptime="10">
+  <topology path="net.graphml"/>
+  <plugin id="tgen" path="tgen"/>
+  <host id="s" loglevel="debug" heartbeatfrequency="5">
+    <process plugin="tgen" starttime="1" arguments="server port=80"/>
+  </host>
+</shadow>"""
+    from shadow_tpu.tools.convert_config import convert
+
+    converted = convert(legacy, base_dir=str(tmp_path))
+    # self-contained: the GraphML text is inlined, not the path
+    assert "net.graphml" not in converted
+    assert "<node" in converted
+    # diagnostics attributes survive the round trip
+    assert 'loglevel="debug"' in converted
+    assert 'heartbeatfrequency="5"' in converted
+    # parses without the original file present
+    b = parse_config(converted)
+    assert b.topology_text.strip().startswith("<graphml")
