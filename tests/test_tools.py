@@ -4,6 +4,8 @@ strip_log_for_compare.py)."""
 
 import json
 
+import pytest
+
 from shadow_tpu.config import expand_hosts, parse_config
 from shadow_tpu.tools.convert_config import convert
 from shadow_tpu.tools.generate_config import main as generate_main
@@ -95,3 +97,54 @@ def test_convert_inlines_path_topology_and_keeps_diagnostics(tmp_path):
     # parses without the original file present
     b = parse_config(converted)
     assert b.topology_text.strip().startswith("<graphml")
+
+
+@pytest.mark.slow
+def test_generated_topology_runs_baseline_config2_shape():
+    """BASELINE config 2 shape: 100-host TGen bulk transfer over a
+    multi-PoI internet-like topology (the role of the reference's
+    measured resource/topology.graphml.xml.xz, synthesized originally
+    here). Hosts attach across PoIs by hints; transfers must complete."""
+    import textwrap
+
+    import jax
+
+    from shadow_tpu.sim import build_simulation
+    from shadow_tpu.tools.generate_topology import generate
+
+    topo = generate(n_pois=12, seed=3)
+    hosts = []
+    for i in range(50):
+        hosts.append(
+            f'<host id="bulkserver{i}" countrycodehint="US">'
+            '<process plugin="tgen" starttime="1" '
+            'arguments="server port=8888"/></host>'
+        )
+        hosts.append(
+            f'<host id="bulkclient{i}" countrycodehint="DE">'
+            f'<process plugin="tgen" starttime="2" '
+            f'arguments="peers=bulkserver{i}:8888 sendsize=2KiB '
+            f'recvsize=64KiB count=1"/></host>'
+        )
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="30">
+      <topology><![CDATA[{topo}]]></topology>
+      <plugin id="tgen" path="tgen"/>
+      {''.join(hosts)}
+    </shadow>"""))
+    sim = build_simulation(cfg, seed=2)
+    st = sim.run()
+    done = int(jax.device_get(st.hosts.app.streams_done.sum()))
+    assert done == 50, done
+    # hint-driven attachment really lands hosts on distinct PoIs:
+    # US-hinted and DE-hinted attachments must resolve to different
+    # vertices of the generated topology
+    from shadow_tpu.net.topology import Topology
+    from shadow_tpu.tools.generate_topology import generate as gen2
+
+    topo2 = Topology.from_graphml(gen2(n_pois=12, seed=3))
+    us = topo2.attach(countrycode_hint="US")
+    de = topo2.attach(countrycode_hint="DE")
+    assert topo2.vertices[us].countrycode == "US"
+    assert topo2.vertices[de].countrycode == "DE"
+    assert us != de
