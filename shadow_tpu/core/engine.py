@@ -414,21 +414,15 @@ class Engine:
             jnp.broadcast_to(self_gid, (n, k)), dst
         )
 
-        def roll(key, kidx):
-            return jax.random.uniform(jax.random.fold_in(key, kidx))
-
         def rolls(offset):
-            return jax.vmap(
-                lambda key: jax.vmap(lambda i: roll(key, i))(
-                    jnp.arange(k, dtype=jnp.uint32) + offset
-                )
-            )(rkeys)
+            # one fused elementwise threefry pass over all [N, K] lanes
+            return srng.uniform_lanes(rkeys, k, offset)
 
         if self._use_jitter:
             # seeded symmetric latency noise, per packet (the reference
             # carries per-edge jitter attrs, topology.c:101-105; paths
             # accumulate them like latency)
-            uj = rolls(jnp.uint32(k))
+            uj = rolls(k)
             lat = jnp.maximum(
                 lat + ((uj * 2.0 - 1.0) * jit.astype(jnp.float32)).astype(
                     jnp.int64
@@ -439,7 +433,7 @@ class Engine:
         t_remote = jnp.maximum(t + lat, window_end)
         t = jnp.where(is_local, t, t_remote)
 
-        u = rolls(jnp.uint32(0))
+        u = rolls(0)
         dropped = (~is_local) & (u >= rel) & emask
         final_mask = emask & ~dropped
 
@@ -553,7 +547,7 @@ class Engine:
                 jnp.broadcast_to(gids[:, None], (h, b)).reshape(-1),
                 cnts.reshape(-1),
             )
-            hk = hk.reshape((h, b))
+            hk = hk.reshape((h, b, 2))
 
             hosts2, emit = jax.vmap(self.batch_handler)(hosts, evs, hk)
             n_exec = jnp.sum(bvalid, axis=1, dtype=jnp.int32)

@@ -19,6 +19,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from shadow_tpu.core import rng as srng
 from shadow_tpu.core.engine import Emit, Engine, EngineConfig, ConstantNetwork
 from shadow_tpu.core.events import Events
 from shadow_tpu.core.timebase import MILLISECOND, TIME_INVALID
@@ -71,14 +72,14 @@ def _make_draw(n_hosts_global, mean_delay_ns, hot_hosts, hot_weight):
     guarantee cannot be broken by the two drifting apart."""
 
     def draw(key):
-        kp, kd, kh = jax.random.split(key, 3)
-        peer = jax.random.randint(kp, (), 0, n_hosts_global, dtype=jnp.int32)
+        kp, kd, kh = srng.split(key, 3)
+        peer = srng.randint(kp, 0, n_hosts_global)
         if hot_hosts > 0 and hot_weight > 0.0:
-            hot = jax.random.uniform(kh) < hot_weight
-            peer_hot = jax.random.randint(kp, (), 0, hot_hosts, dtype=jnp.int32)
+            hot = srng.uniform(kh) < hot_weight
+            peer_hot = srng.randint(kp, 0, hot_hosts)
             peer = jnp.where(hot, peer_hot, peer)
         delay = (
-            jax.random.exponential(kd, dtype=jnp.float32) * mean_delay_ns
+            srng.exponential(kd) * mean_delay_ns
         ).astype(jnp.int64)
         return peer, delay
 

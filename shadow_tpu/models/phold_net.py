@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shadow_tpu.config import parse_kv_arguments, resolve_path
+from shadow_tpu.core import rng as srng
 from shadow_tpu.core.engine import Emit
 from shadow_tpu.core.events import Events
 from shadow_tpu.host.sockets import PROTO_UDP
@@ -101,7 +102,7 @@ class PholdNetModel:
     def _pick_target(self, key):
         """Weighted choice by inverse-CDF (the plugin walks its weight
         array the same way, test_phold.c _phold_chooseTarget)."""
-        u = jax.random.uniform(key)
+        u = srng.uniform(key)
         idx = jnp.searchsorted(self._cdf, u)
         return self._targets[jnp.minimum(idx, len(self._targets) - 1)]
 
