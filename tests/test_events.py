@@ -101,3 +101,46 @@ def test_empty_queue_pop_inactive():
     q, out, active = queue_pop(q, jnp.int64(10**15), gids)
     assert not bool(active.any())
     assert (out.time == TIME_INVALID).all()
+
+
+def test_burst_beyond_merge_w_exercises_fallback_round():
+    """A single destination receiving far more than MERGE_W events in one
+    push must land them all (the lax.cond fallback round), in key order,
+    with only true capacity overflow counted as drops."""
+    from shadow_tpu.core.events import MERGE_W
+
+    n = 3 * MERGE_W  # 72 events to one host, capacity 80: no drops
+    q = EventQueue.create(n_hosts=4, capacity=80)
+    rows = [(1000 - i, 1, 0, i, 0) for i in range(n)]
+    q = queue_push(q, mk_events(rows), jnp.ones(n, bool), host0=0)
+    assert q.size().tolist() == [0, n, 0, 0]
+    assert q.drops.tolist() == [0, 0, 0, 0]
+    # row must hold the full burst sorted by (time, src, seq)
+    times = q.time[1, :n].tolist()
+    assert times == sorted(times) == list(range(1000 - n + 1, 1001))
+
+
+def test_burst_beyond_merge_w_with_capacity_overflow():
+    """Burst > MERGE_W into a small queue: the smallest keys survive and
+    every lost event is accounted as a drop — whichever round it rode."""
+    from shadow_tpu.core.events import MERGE_W
+
+    n = 2 * MERGE_W + 10  # 58 events, capacity 16
+    cap = 16
+    q = EventQueue.create(n_hosts=2, capacity=cap)
+    rows = [(i + 1, 0, 0, i, 0) for i in range(n)]
+    q = queue_push(q, mk_events(rows), jnp.ones(n, bool), host0=0)
+    assert int(q.size()[0]) == cap
+    assert q.time[0, :cap].tolist() == list(range(1, cap + 1))
+    assert int(q.drops[0]) == n - cap
+
+
+def test_negative_time_events_excluded():
+    """Negative times are invalid input (sim times are ns >= 0); they are
+    ignored like out-of-shard destinations and cannot disturb the
+    marker-based placement of valid events."""
+    q = EventQueue.create(n_hosts=2, capacity=4)
+    ev = mk_events([(-1, 0, 0, 0, 0), (-5, 1, 0, 1, 0), (7, 1, 0, 2, 3)])
+    q = queue_push(q, ev, jnp.ones(3, bool), host0=0)
+    assert q.size().tolist() == [0, 1]
+    assert int(q.time[1, 0]) == 7 and int(q.kind[1, 0]) == 3
