@@ -44,5 +44,97 @@ static inline void g_test_fail(void) {}
     } while (0)
 
 #define g_assert_true(expr) g_assert(expr)
+#define g_assert_nonnull(p) g_assert((p) != NULL)
+#define g_assert_cmpint(a, op, b) g_assert((a)op(b))
+#define g_assert_cmpmem(p1, n1, p2, n2)                                    \
+    g_assert((size_t)(n1) == (size_t)(n2) &&                               \
+             memcmp((p1), (p2), (size_t)(n1)) == 0)
+
+/* ---- the minimal type/string/test-runner surface the reference's
+ * dual-run test mains use (g_test_init/add/run + GError string
+ * parsing); a deliberately tiny reimplementation, not GLib ---- */
+
+#include <string.h>
+#include <stdarg.h>
+
+typedef char gchar;
+typedef int gboolean;
+typedef uint64_t guint64;
+typedef struct GError {
+    int code;
+    char message[128];
+} GError;
+
+#define g_assert_no_error(err) g_assert((err) == NULL)
+
+static inline void g_free(void* p) { free(p); }
+
+static inline int g_strcmp0(const char* a, const char* b) {
+    if (!a) return b ? -1 : 0;
+    if (!b) return 1;
+    return strcmp(a, b);
+}
+
+static inline gchar* g_strdup_printf(const char* fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    char* out = NULL;
+    if (vasprintf(&out, fmt, ap) < 0) out = NULL;
+    va_end(ap);
+    return out;
+}
+
+static inline void g_set_prgname(const char* n) { (void)n; }
+static inline void g_test_init(int* argc, char*** argv, ...) {
+    (void)argc;
+    (void)argv;
+}
+
+static inline gboolean g_ascii_string_to_unsigned(
+    const char* str, unsigned base, guint64 min, guint64 max,
+    guint64* out, GError** error) {
+    char* end = NULL;
+    unsigned long long v = strtoull(str, &end, (int)base);
+    if (!end || *end || end == str || v < min || v > max) {
+        if (error) {
+            static GError e;
+            e.code = 1;
+            snprintf(e.message, sizeof e.message, "bad unsigned: %s", str);
+            *error = &e;
+        }
+        return 0;
+    }
+    if (out) *out = v;
+    return 1;
+}
+
+/* test registry: g_test_run executes registered cases in order, exiting
+ * nonzero on the first failure (each case exits on failed assertion) */
+typedef struct {
+    const char* name;
+    void (*fn)(const void*);
+    const void* data;
+} _GTestCase;
+static _GTestCase _g_tests[32];
+static int _g_n_tests = 0;
+
+static inline void g_test_add_data_func(const char* name,
+                                        const void* data,
+                                        void (*fn)(const void*)) {
+    if (_g_n_tests < 32) {
+        _g_tests[_g_n_tests].name = name;
+        _g_tests[_g_n_tests].fn = fn;
+        _g_tests[_g_n_tests].data = data;
+        _g_n_tests++;
+    }
+}
+
+static inline int g_test_run(void) {
+    for (int i = 0; i < _g_n_tests; i++) {
+        _g_tests[i].fn(_g_tests[i].data);
+        fprintf(stdout, "ok: %s\n", _g_tests[i].name);
+    }
+    return 0;
+}
 
 #endif /* SHADOW_TPU_COMPAT_GLIB_H */

@@ -68,9 +68,18 @@
 
 static const ShimAPI* A = 0;
 
-/* The runtime calls this right after dlmopen'ing a plugin whose
- * namespace contains this library. */
-void shadow_interpose_install(const ShimAPI* api) { A = api; }
+static void vfd_reset_all(void);
+
+/* The runtime calls this right after loading a plugin whose lookup
+ * scope contains this library. When the namespace budget forces shared
+ * copies, SUCCESSIVE runtimes (e.g. one simulation after another in the
+ * same OS process) reuse one interposer copy — its per-process fd
+ * tables then hold the PREVIOUS runtime's state under colliding pids,
+ * so a runtime change clears them. */
+void shadow_interpose_install(const ShimAPI* api) {
+    if (A && api && A->ctx != api->ctx) vfd_reset_all();
+    A = api;
+}
 
 /* ------------------------------------------------------- real fallbacks */
 
@@ -94,6 +103,11 @@ typedef struct EpollWatch {
     int vfd;
     uint32_t events;
     epoll_data_t data;
+    unsigned char reported; /* ET/ONESHOT: event consumed since last
+                               (re-)arm (epoll.c:34-66 watch flags) */
+    uint64_t rep_activity;  /* ET: fd activity counter at report time —
+                               new inbound activity is a fresh edge even
+                               if readiness never visibly dropped */
 } EpollWatch;
 
 typedef struct Vfd {
@@ -101,8 +115,11 @@ typedef struct Vfd {
     unsigned char nonblock;
     unsigned char is_epoll;
     unsigned char is_timer;
+    unsigned char is_udp;
     unsigned char connect_started;
     int rfd; /* runtime fd; -1 for interposer-local (epoll) */
+    uint32_t peer_ip;  /* UDP connect(2) default destination */
+    int peer_port;
     int n_watch, cap_watch;
     EpollWatch* watch;
 } Vfd;
@@ -183,6 +200,16 @@ static void vfd_free(int vfd) {
     memset(v, 0, sizeof(*v));
 }
 
+static void vfd_reset_all(void) {
+    for (int p = 0; p < g_npp; p++) {
+        for (int i = 0; i < g_pp[p].len; i++) free(g_pp[p].tab[i].watch);
+        free(g_pp[p].tab);
+    }
+    free(g_pp);
+    g_pp = 0;
+    g_npp = 0;
+}
+
 /* ----------------------------------------------------------- sockets */
 
 int socket(int domain, int type, int protocol) {
@@ -191,14 +218,17 @@ int socket(int domain, int type, int protocol) {
         errno = ENOSYS;
         return -1;
     }
-    if (domain != AF_INET || (type & 0xFF) != SOCK_STREAM) {
-        /* the simulated stack is TCP/IPv4 for interposed plugins; the
-         * reference likewise forwards only what its host model
-         * implements (host.c:773-860) */
+    int base_type = type & 0xFF;
+    if (domain != AF_INET ||
+        (base_type != SOCK_STREAM && base_type != SOCK_DGRAM)) {
+        /* the simulated stack is TCP+UDP/IPv4 for interposed plugins;
+         * the reference likewise forwards only what its host model
+         * implements (host.c:773-860, udp.c:26-60) */
         errno = EAFNOSUPPORT;
         return -1;
     }
-    int rfd = A->sock_socket(A->ctx);
+    int is_udp = base_type == SOCK_DGRAM;
+    int rfd = is_udp ? A->udp_socket(A->ctx) : A->sock_socket(A->ctx);
     if (rfd < 0) {
         errno = EMFILE;
         return -1;
@@ -211,6 +241,7 @@ int socket(int domain, int type, int protocol) {
     }
     Vfd* v = vfd_get(vfd);
     v->nonblock = (type & SOCK_NONBLOCK) ? 1 : 0;
+    v->is_udp = (unsigned char)is_udp;
     return vfd;
 }
 
@@ -224,6 +255,15 @@ int bind(int fd, const struct sockaddr* addr, socklen_t len) {
     if (addr && len >= sizeof(struct sockaddr_in) &&
         addr->sa_family == AF_INET) {
         port = ntohs(((const struct sockaddr_in*)addr)->sin_port);
+    }
+    if (v->is_udp) {
+        /* datagram bind goes straight into the device demux (udp.c
+         * association; TCP defers to listen) */
+        if (A->udp_bind(A->ctx, v->rfd, port) < 0) {
+            errno = EADDRINUSE;
+            return -1;
+        }
+        return 0;
     }
     if (A->sock_bind(A->ctx, v->rfd, port) < 0) {
         errno = EBADF;
@@ -307,6 +347,14 @@ int connect(int fd, const struct sockaddr* addr, socklen_t len) {
         errno = EINVAL;
         return -1;
     }
+    if (v->is_udp) {
+        /* datagram connect just fixes the default destination
+         * (udp.c:26-60 "connect just sets default peer") */
+        const struct sockaddr_in* du = (const struct sockaddr_in*)addr;
+        v->peer_ip = ntohl(du->sin_addr.s_addr);
+        v->peer_port = ntohs(du->sin_port);
+        return 0;
+    }
     if (v->connect_started) {
         /* repeat connect() after EINPROGRESS: 0 once established (the
          * loop idiom the reference's own tests use, test_tcp.c
@@ -339,6 +387,20 @@ ssize_t send(int fd, const void* buf, size_t n, int flags) {
         errno = EBADF;
         return -1;
     }
+    if (v->is_udp) {
+        /* connected-UDP send: to the default peer set by connect() */
+        if (!v->peer_ip && !v->peer_port) {
+            errno = EDESTADDRREQ;
+            return -1;
+        }
+        int64_t rv = A->udp_sendto(A->ctx, v->rfd, v->peer_ip,
+                                   v->peer_port, buf, (int64_t)n);
+        if (rv < 0) {
+            errno = EBADF;
+            return -1;
+        }
+        return (ssize_t)rv;
+    }
     int64_t rv = A->sock_send(A->ctx, v->rfd, buf, (int64_t)n);
     if (rv < 0) {
         errno = EPIPE;
@@ -349,8 +411,19 @@ ssize_t send(int fd, const void* buf, size_t n, int flags) {
 
 ssize_t sendto(int fd, const void* buf, size_t n, int flags,
                const struct sockaddr* addr, socklen_t alen) {
-    (void)addr;
-    (void)alen;
+    Vfd* v = vfd_get(fd);
+    if (v && v->is_udp && addr && alen >= sizeof(struct sockaddr_in) &&
+        addr->sa_family == AF_INET) {
+        const struct sockaddr_in* sin = (const struct sockaddr_in*)addr;
+        int64_t rv = A->udp_sendto(A->ctx, v->rfd,
+                                   ntohl(sin->sin_addr.s_addr),
+                                   ntohs(sin->sin_port), buf, (int64_t)n);
+        if (rv < 0) {
+            errno = EBADF;
+            return -1;
+        }
+        return (ssize_t)rv;
+    }
     return send(fd, buf, n, flags);
 }
 
@@ -360,6 +433,19 @@ ssize_t recv(int fd, void* buf, size_t cap, int flags) {
     if (!v) {
         errno = EBADF;
         return -1;
+    }
+    if (v->is_udp) {
+        if (v->nonblock && A->udp_pending(A->ctx, v->rfd) <= 0) {
+            errno = EAGAIN;
+            return -1;
+        }
+        int64_t rv = A->udp_recvfrom(A->ctx, v->rfd, buf, (int64_t)cap,
+                                     0, 0);
+        if (rv < 0) {
+            errno = EBADF;
+            return -1;
+        }
+        return (ssize_t)rv;
     }
     if (v->nonblock) {
         if (A->readable_n(A->ctx, v->rfd) <= 0 &&
@@ -378,6 +464,23 @@ ssize_t recv(int fd, void* buf, size_t cap, int flags) {
 
 ssize_t recvfrom(int fd, void* buf, size_t cap, int flags,
                  struct sockaddr* addr, socklen_t* alen) {
+    Vfd* v = vfd_get(fd);
+    if (v && v->is_udp) {
+        if (v->nonblock && A->udp_pending(A->ctx, v->rfd) <= 0) {
+            errno = EAGAIN;
+            return -1;
+        }
+        uint32_t ip = 0;
+        int port = 0;
+        int64_t rv = A->udp_recvfrom(A->ctx, v->rfd, buf, (int64_t)cap,
+                                     &ip, &port);
+        if (rv < 0) {
+            errno = EBADF;
+            return -1;
+        }
+        fill_inet_addr(addr, alen, ip, port);
+        return (ssize_t)rv;
+    }
     fill_inet_addr(addr, alen, 0, 0);
     return recv(fd, buf, cap, flags);
 }
@@ -607,6 +710,24 @@ int pipe2(int fds[2], int flags) {
 
 int pipe(int fds[2]) { return pipe2(fds, 0); }
 
+int socketpair(int domain, int type, int protocol, int fds[2]) {
+    (void)protocol;
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    if (domain != AF_UNIX || (type & 0xFF) != SOCK_STREAM) {
+        errno = EAFNOSUPPORT;
+        return -1;
+    }
+    /* the runtime's pipe endpoints are symmetric linked byte queues
+     * (each write lands on the peer's read buffer), which is exactly
+     * the reference's Channel: one object backing both pipes AND
+     * socketpairs (channel.c:22-33) — so a socketpair is a pipe pair
+     * used full-duplex */
+    return pipe2(fds, (type & SOCK_NONBLOCK) ? O_NONBLOCK : 0);
+}
+
 /* ------------------------------------------------------------- timerfd */
 
 int timerfd_create(int clockid, int flags) {
@@ -714,12 +835,19 @@ unsigned int sleep(unsigned int s) {
 
 int getaddrinfo(const char* node, const char* service,
                 const struct addrinfo* hints, struct addrinfo** res) {
-    if (!node || !res) return EAI_NONAME;
+    if (!res) return EAI_NONAME;
     uint32_t ip = 0;
     struct in_addr parsed;
-    if (A) ip = A->resolve(A->ctx, node);
-    if (!ip && inet_aton(node, &parsed)) ip = ntohl(parsed.s_addr);
-    if (!ip) return EAI_NONAME;
+    if (!node) {
+        /* NULL node: AI_PASSIVE = wildcard bind address, else loopback
+         * (both route to "this host" in the simulated network) */
+        ip = (hints && (hints->ai_flags & AI_PASSIVE)) ? 0 : 0x7F000001u;
+        if (!service) return EAI_NONAME;
+    } else {
+        if (A) ip = A->resolve(A->ctx, node);
+        if (!ip && inet_aton(node, &parsed)) ip = ntohl(parsed.s_addr);
+        if (!ip) return EAI_NONAME;
+    }
 
     struct addrinfo* ai = calloc(1, sizeof(*ai));
     struct sockaddr_in* sa = calloc(1, sizeof(*sa));
@@ -734,7 +862,8 @@ int getaddrinfo(const char* node, const char* service,
     ai->ai_family = AF_INET;
     ai->ai_socktype = hints && hints->ai_socktype ? hints->ai_socktype
                                                   : SOCK_STREAM;
-    ai->ai_protocol = IPPROTO_TCP;
+    ai->ai_protocol =
+        ai->ai_socktype == SOCK_DGRAM ? IPPROTO_UDP : IPPROTO_TCP;
     ai->ai_addrlen = sizeof(*sa);
     ai->ai_addr = (struct sockaddr*)sa;
     *res = ai;
@@ -924,7 +1053,10 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event* event) {
         return -1;
     }
     if (!vfd_get(fd)) {
-        errno = EBADF;
+        /* a live REAL fd here is a regular file: epoll rejects those
+         * with EPERM (the reference's epoll does the same; its test
+         * asserts the errno, test_epoll.c _test_creat) */
+        errno = get_real_fcntl()(fd, F_GETFD, 0) != -1 ? EPERM : EBADF;
         return -1;
     }
     for (int i = 0; i < e->n_watch; i++) {
@@ -935,6 +1067,7 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event* event) {
             }
             e->watch[i].events = event->events;
             e->watch[i].data = event->data;
+            e->watch[i].reported = 0; /* MOD re-arms ET/ONESHOT */
             return 0;
         }
     }
@@ -955,6 +1088,7 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event* event) {
     e->watch[e->n_watch].vfd = fd;
     e->watch[e->n_watch].events = event->events;
     e->watch[e->n_watch].data = event->data;
+    e->watch[e->n_watch].reported = 0;
     e->n_watch++;
     return 0;
 }
@@ -992,26 +1126,64 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
         errno = ENOMEM;
         return -1;
     }
-    for (int i = 0; i < n; i++) {
-        rfds[i] = vfd_get(e->watch[i].vfd)->rfd;
-        want[i] = ((e->watch[i].events & EPOLLIN) ? 1 : 0) |
-                  ((e->watch[i].events & EPOLLOUT) ? 2 : 0);
-    }
-    int got = A->poll_many(A->ctx, rfds, want, n, ms_to_ns(timeout_ms),
-                           ready);
+
+    /* Edge-trigger / oneshot discipline (epoll.c:34-66 watch flags): a
+     * watch whose event was already collected is DISARMED — ONESHOT
+     * until EPOLL_CTL_MOD, ET until a fresh edge (readiness observed
+     * low, or the fd's inbound-activity counter moved past the value
+     * recorded at report time — catching edges that rise AND fall
+     * between two waits). Disarmed watches are excluded from the
+     * blocking wait so they can neither wake it nor be re-reported. */
     int count = 0;
-    for (int i = 0; i < n && count < maxevents && got > 0; i++) {
-        if (!ready[i]) continue;
-        uint32_t ev = 0;
-        if ((e->watch[i].events & EPOLLIN) && probe_read(rfds[i]))
-            ev |= EPOLLIN;
-        if ((e->watch[i].events & EPOLLOUT) && A->writable(A->ctx, rfds[i]))
-            ev |= EPOLLOUT;
-        if (A->conn_status(A->ctx, rfds[i]) == -1) ev |= EPOLLERR;
-        if (!ev) continue;
-        events[count].events = ev;
-        events[count].data = e->watch[i].data;
-        count++;
+    for (int pass = 0; pass < 2; pass++) {
+        int n_armed = 0;
+        for (int i = 0; i < n; i++) {
+            rfds[i] = vfd_get(e->watch[i].vfd)->rfd;
+            want[i] = ((e->watch[i].events & EPOLLIN) ? 1 : 0) |
+                      ((e->watch[i].events & EPOLLOUT) ? 2 : 0);
+        }
+        /* one batched zero-timeout probe over every watch */
+        A->poll_many(A->ctx, rfds, want, n, 0, ready);
+        for (int i = 0; i < n; i++) {
+            EpollWatch* w = &e->watch[i];
+            if (w->reported && (w->events & EPOLLET) &&
+                (!ready[i] ||
+                 A->fd_activity(A->ctx, rfds[i]) != w->rep_activity))
+                w->reported = 0; /* fresh edge */
+            int armed = !(w->reported &&
+                          (w->events & (EPOLLET | EPOLLONESHOT)));
+            if (!armed) {
+                want[i] = 0;
+                ready[i] = 0;
+            }
+            n_armed += armed && want[i];
+        }
+        for (int i = 0; i < n && count < maxevents; i++) {
+            if (!ready[i]) continue;
+            EpollWatch* w = &e->watch[i];
+            uint32_t ev = 0;
+            if ((w->events & EPOLLIN) && probe_read(rfds[i]))
+                ev |= EPOLLIN;
+            if ((w->events & EPOLLOUT) && A->writable(A->ctx, rfds[i]))
+                ev |= EPOLLOUT;
+            if (A->conn_status(A->ctx, rfds[i]) == -1) ev |= EPOLLERR;
+            if (!ev) continue;
+            events[count].events = ev;
+            events[count].data = w->data;
+            w->reported = 1;
+            w->rep_activity = A->fd_activity(A->ctx, rfds[i]);
+            count++;
+        }
+        if (count || pass == 1 || timeout_ms == 0 || n_armed == 0) {
+            if (!count && timeout_ms != 0 && n_armed == 0)
+                /* everything disarmed: plain timeout sleep */
+                A->sleep_ns(A->ctx, ms_to_ns(
+                    timeout_ms < 0 ? 3600000 : timeout_ms));
+            break;
+        }
+        /* block until an ARMED watch turns ready (or timeout), then
+         * rescan once */
+        A->poll_many(A->ctx, rfds, want, n, ms_to_ns(timeout_ms), ready);
     }
     if (n > 64) {
         free(rfds);
@@ -1056,6 +1228,228 @@ char* getenv(const char* name) {
     /* a dlmopen'd secondary libc never ran __libc_start_main, so its
      * environ is empty; resolve via the runtime's base namespace */
     if (A) return (char*)A->env_get(A->ctx, name);
+    return 0;
+}
+
+/* --------------------------------------------------------------- signals */
+
+/* Per-process handler tables with ONE real trampoline per signal: the
+ * virtual process installs its handler through the interposed
+ * sigaction/signal, a real delivery (e.g. the plugin faulting on its
+ * own bug, src/test/signal/test_signal.c dereferences NULL) routes to
+ * the CURRENT process's handler. The reference's preload maps the same
+ * family (preload_defs.h signal rows -> process_emu_*). Handlers that
+ * never return (the common exit() pattern) leave the signal frame on
+ * the green stack; swapcontext restores the scheduler's signal mask. */
+
+#include <signal.h>
+
+#define SIG_TABLE_MAX 64
+
+typedef void (*sig_handler_t)(int);
+
+typedef struct SigProc {
+    sig_handler_t h[SIG_TABLE_MAX];
+    unsigned char ignored[SIG_TABLE_MAX]; /* SIG_IGN != "no handler":
+                                             an ignored signal must be
+                                             swallowed, not re-raised */
+} SigProc;
+
+static SigProc* g_sig = 0;
+static int g_nsig = 0;
+static unsigned char g_sig_installed[SIG_TABLE_MAX];
+
+REAL(int, sigaction, (int, const struct sigaction*, struct sigaction*))
+
+static SigProc* sig_pp(void) {
+    int pid = A ? A->current_pid(A->ctx) : -1;
+    if (pid < 0) return 0;
+    if (pid >= g_nsig) {
+        int n = g_nsig ? g_nsig : 16;
+        while (n <= pid) n *= 2;
+        SigProc* t = realloc(g_sig, n * sizeof(SigProc));
+        if (!t) return 0;
+        memset(t + g_nsig, 0, (n - g_nsig) * sizeof(SigProc));
+        g_sig = t;
+        g_nsig = n;
+    }
+    return &g_sig[pid];
+}
+
+static void sig_trampoline(int sn) {
+    SigProc* s = sig_pp();
+    if (s && sn >= 0 && sn < SIG_TABLE_MAX) {
+        if (s->h[sn]) {
+            s->h[sn](sn);
+            return;
+        }
+        if (s->ignored[sn]) return; /* SIG_IGN: swallow */
+    }
+    /* no virtual handler: restore default and re-raise (real fatal) */
+    struct sigaction dfl;
+    memset(&dfl, 0, sizeof dfl);
+    dfl.sa_handler = SIG_DFL;
+    get_real_sigaction()(sn, &dfl, 0);
+    raise(sn);
+}
+
+int sigaction(int signum, const struct sigaction* act,
+              struct sigaction* oldact) {
+    if (signum <= 0 || signum >= SIG_TABLE_MAX) {
+        errno = EINVAL;
+        return -1;
+    }
+    SigProc* s = sig_pp();
+    if (!s) {
+        errno = ENOSYS;
+        return -1;
+    }
+    if (oldact) {
+        memset(oldact, 0, sizeof *oldact);
+        oldact->sa_handler = s->h[signum];
+    }
+    if (!act) return 0;
+    s->h[signum] = act->sa_handler;
+    s->ignored[signum] = 0;
+    if (act->sa_handler == SIG_IGN || act->sa_handler == SIG_DFL) {
+        s->h[signum] = 0;
+        s->ignored[signum] = act->sa_handler == SIG_IGN;
+        if (!s->ignored[signum]) return 0;
+        /* SIG_IGN still needs the real trampoline installed so the
+         * delivery reaches the swallow path instead of the default
+         * disposition */
+    }
+    if (!g_sig_installed[signum]) {
+        struct sigaction real;
+        memset(&real, 0, sizeof real);
+        real.sa_handler = sig_trampoline;
+        /* NODEFER: a handler that longjmps/exits out would otherwise
+         * leave the signal blocked for the whole simulator thread */
+        real.sa_flags = SA_NODEFER;
+        if (get_real_sigaction()(signum, &real, 0) != 0) return -1;
+        g_sig_installed[signum] = 1;
+    }
+    return 0;
+}
+
+sig_handler_t signal(int signum, sig_handler_t handler) {
+    struct sigaction act, old;
+    memset(&act, 0, sizeof act);
+    act.sa_handler = handler;
+    if (sigaction(signum, &act, &old) != 0) return SIG_ERR;
+    return old.sa_handler ? old.sa_handler : SIG_DFL;
+}
+
+/* -------------------------------------------------------------- pthreads */
+
+/* The reference maps plugin pthreads onto its green-thread runtime
+ * (src/external/rpth/pthread.c, SURVEY.md §2.4); this surface does the
+ * same against the ShimAPI v4 thread calls. pthread_t carries the green
+ * thread's tid. Mutex/cond state is kept inside the caller's
+ * pthread_mutex_t/pthread_cond_t storage by the runtime, so static
+ * PTHREAD_*_INITIALIZER objects need no init call. */
+
+#include <pthread.h>
+
+int pthread_create(pthread_t* thread, const pthread_attr_t* attr,
+                   void* (*fn)(void*), void* arg) {
+    (void)attr;
+    if (!A) {
+        errno = ENOSYS;
+        return ENOSYS;
+    }
+    int tid = A->thread_create(A->ctx, fn, arg);
+    if (tid < 0) return EAGAIN;
+    *thread = (pthread_t)tid;
+    return 0;
+}
+
+int pthread_join(pthread_t thread, void** retval) {
+    if (!A) return ENOSYS;
+    return A->thread_join(A->ctx, (int)thread, retval) == 0 ? 0 : EINVAL;
+}
+
+pthread_t pthread_self(void) {
+    return A ? (pthread_t)A->thread_self(A->ctx) : 0;
+}
+
+int pthread_equal(pthread_t a, pthread_t b) { return a == b; }
+
+int pthread_detach(pthread_t thread) {
+    (void)thread; /* green-thread stacks are reclaimed at process end */
+    return 0;
+}
+
+void pthread_exit(void* retval) {
+    if (A) A->thread_exit(A->ctx, retval); /* never returns */
+    _Exit(0);
+}
+
+int pthread_mutex_init(pthread_mutex_t* m, const pthread_mutexattr_t* a) {
+    (void)a;
+    memset(m, 0, sizeof(*m));
+    return 0;
+}
+
+int pthread_mutex_destroy(pthread_mutex_t* m) {
+    (void)m;
+    return 0;
+}
+
+int pthread_mutex_lock(pthread_mutex_t* m) {
+    if (!A) return ENOSYS;
+    return A->mutex_lock(A->ctx, m);
+}
+
+int pthread_mutex_trylock(pthread_mutex_t* m) {
+    if (!A) return ENOSYS;
+    return A->mutex_trylock(A->ctx, m);
+}
+
+int pthread_mutex_unlock(pthread_mutex_t* m) {
+    if (!A) return ENOSYS;
+    return A->mutex_unlock(A->ctx, m);
+}
+
+int pthread_cond_init(pthread_cond_t* c, const pthread_condattr_t* a) {
+    (void)a;
+    memset(c, 0, sizeof(*c));
+    return 0;
+}
+
+int pthread_cond_destroy(pthread_cond_t* c) {
+    (void)c;
+    return 0;
+}
+
+int pthread_cond_wait(pthread_cond_t* c, pthread_mutex_t* m) {
+    if (!A) return ENOSYS;
+    return A->cond_wait(A->ctx, c, m);
+}
+
+int pthread_cond_signal(pthread_cond_t* c) {
+    if (!A) return ENOSYS;
+    return A->cond_signal(A->ctx, c);
+}
+
+int pthread_cond_broadcast(pthread_cond_t* c) {
+    if (!A) return ENOSYS;
+    return A->cond_signal(A->ctx, c); /* signal wakes all waiters */
+}
+
+int pthread_attr_init(pthread_attr_t* a) {
+    memset(a, 0, sizeof(*a));
+    return 0;
+}
+
+int pthread_attr_destroy(pthread_attr_t* a) {
+    (void)a;
+    return 0;
+}
+
+int pthread_attr_setdetachstate(pthread_attr_t* a, int state) {
+    (void)a;
+    (void)state;
     return 0;
 }
 

@@ -131,6 +131,50 @@ typedef struct ShimAPI {
     int (*poll_many)(void* ctx, const int* fds, const unsigned char* want,
                      int nfds, int64_t timeout_ns,
                      unsigned char* ready_out);
+
+    /* ---- v3: SOCK_DGRAM (the reference's full UDP socket emulation
+     * for plugins, src/main/host/descriptor/udp.c:26-60; datagram
+     * payloads stay host-side exactly like TCP streams). ---- */
+
+    int (*udp_socket)(void* ctx);
+    /* bind into the device demux (port 0 = ephemeral); returns the
+     * bound port */
+    int (*udp_bind)(void* ctx, int fd, int port);
+    /* one datagram to (virtual IPv4 host-order, port); implicit bind on
+     * an unbound sender */
+    int64_t (*udp_sendto)(void* ctx, int fd, uint32_t ip, int port,
+                          const void* buf, int64_t n);
+    /* blocks; one datagram per call, source address out-params */
+    int64_t (*udp_recvfrom)(void* ctx, int fd, void* buf, int64_t cap,
+                            uint32_t* ip_out, int* port_out);
+    /* pending datagram count (poll/ioctl fast path) */
+    int (*udp_pending)(void* ctx, int fd);
+
+    /* ---- v4: green-thread pthread surface (the reference's rpth
+     * pthread ABI mapped onto cooperative threads,
+     * src/external/rpth/pthread.c). Mutex/cond state lives inside the
+     * caller's pthread_mutex_t/pthread_cond_t storage, so
+     * PTHREAD_*_INITIALIZER statics work untouched. ---- */
+
+    /* spawn a sibling green thread in the current virtual process;
+     * returns its tid (> 0), runnable immediately */
+    int (*thread_create)(void* ctx, void* (*fn)(void*), void* arg);
+    /* block until thread `tid` finishes; retval out-param */
+    int (*thread_join)(void* ctx, int tid, void** retval);
+    int (*thread_self)(void* ctx);
+    void (*thread_exit)(void* ctx, void* retval); /* never returns */
+    int (*mutex_lock)(void* ctx, void* mutex);    /* blocks */
+    int (*mutex_trylock)(void* ctx, void* mutex); /* 0 or EBUSY */
+    int (*mutex_unlock)(void* ctx, void* mutex);
+    int (*cond_wait)(void* ctx, void* cond, void* mutex); /* blocks */
+    int (*cond_signal)(void* ctx, void* cond); /* wakes all: spurious
+                                                  wakeups are POSIX-legal */
+
+    /* ---- v5: monotone per-fd inbound-activity counter (bytes, FINs,
+     * accepts, datagrams, connect transitions). Edge-triggered epoll
+     * compares it across waits so a ready-fall-then-rise between two
+     * waits still reads as a fresh edge. ---- */
+    uint64_t (*fd_activity)(void* ctx, int fd);
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

@@ -27,11 +27,13 @@
 #include "shim_api.h"
 
 #include <dlfcn.h>
+#include <fcntl.h>
 #include <stdarg.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <ucontext.h>
+#include <unistd.h>
 
 #include <deque>
 #include <map>
@@ -62,6 +64,9 @@ enum ReqOp : int32_t {
     REQ_LOG = 7,
     REQ_TIMER = 8, /* a0 = absolute deadline ns, a1 = interval ns (0=one
                       shot); fd = timer fd */
+    REQ_UDP_BIND = 9, /* port = requested port (0 = ephemeral) */
+    REQ_SENDTO = 10,  /* port = dst port, a0 = (seq << 32) | nbytes,
+                         a1 = dst virtual IPv4 (host order) */
 };
 
 enum CompOp : int32_t {
@@ -80,6 +85,24 @@ enum BlockKind : int32_t {
     BLK_SLEEP = 4,
     BLK_TIMER = 5,
     BLK_POLL = 6,
+    BLK_JOIN = 7,   /* pthread_join: waits for a sibling thread's exit */
+    BLK_MUTEX = 8,  /* pthread_mutex_lock: waits for *block_ptr unlock */
+    BLK_COND = 9,   /* pthread_cond_wait: waits for a generation bump */
+};
+
+/* Mutex/cond state lives INSIDE the plugin's pthread_mutex_t/cond_t
+ * storage (both are >= 8 bytes and PTHREAD_*_INITIALIZER is all-zeros
+ * for the default kinds, so static initialization works untouched).
+ * Cooperative green threads need no atomics: only one thread runs at a
+ * time (the same property rpth's pthread ABI leans on,
+ * src/external/rpth/pthread.c). */
+struct ShimMutex {
+    int32_t locked;
+    int32_t owner_tid;
+};
+struct ShimCond {
+    uint32_t gen;     /* bumped by signal/broadcast; waiters recheck */
+    int32_t waiters;
 };
 
 } // namespace
@@ -109,6 +132,17 @@ struct ShimComp {
 
 namespace {
 
+struct Datagram {
+    uint32_t src_ip = 0;   /* virtual IPv4, host order */
+    int32_t src_port = 0;
+    std::string bytes;
+};
+
+struct OutDgram {
+    int64_t sent_ns = 0;   /* virtual send time, for pruning */
+    std::string bytes;
+};
+
 struct Endpoint {
     std::string inbuf;   /* bytes delivered by the simulated network */
     std::string outbuf;  /* bytes written by the app, awaiting delivery */
@@ -125,22 +159,44 @@ struct Endpoint {
     int32_t conn = 0;        /* 0 idle/in-progress, 1 established, -1 refused */
     bool connect_started = false;
     int32_t local_port = 0;  /* bind/listen port (getsockname) */
+    /* v3: UDP (the reference emulates full SOCK_DGRAM sockets for
+     * plugins, src/main/host/descriptor/udp.c:26-60). Datagram PAYLOADS
+     * stay host-side like TCP streams: outgoing datagrams wait in
+     * udp_out keyed by a per-fd sequence number until the device UDP
+     * reports delivery (or are pruned once undeliverably old — a
+     * reliability-roll drop on the device leaves no tombstone) */
+    bool is_udp = false;
+    int64_t udp_seq = 0;                 /* next outgoing datagram seq */
+    std::map<int64_t, OutDgram> udp_out; /* in-flight, awaiting delivery */
+    std::deque<Datagram> udp_in;         /* delivered, awaiting recvfrom */
+    /* monotone inbound-activity counter (bytes/FIN/accepts/datagrams/
+     * conn transitions): edge-triggered epoll watches compare it across
+     * waits, so an edge that both rises and falls between two waits is
+     * still observed (epoll.c edge semantics) */
+    uint64_t activity = 0;
 };
 
-struct Proc {
-    int32_t pid = -1;
-    int32_t host = -1;
+struct Proc;
+
+/* One green thread. tid 0 is the process's main thread (plugin entry);
+ * higher tids come from pthread_create — the reference's rpth maps
+ * plugin pthreads onto its cooperative scheduler the same way
+ * (src/external/rpth/pthread.c, pth_spawn). */
+struct GThread {
+    Proc* proc = nullptr;
+    int32_t tid = 0;
     ucontext_t ctx{};
     ucontext_t sched_ctx{};
     char* stack = nullptr;
-    bool started = false;
     bool done = false;
-    int exit_code = 0;
+    void* retval = nullptr;
 
     int32_t blocked_on = BLK_NONE;
     int32_t block_fd = -1;
     int64_t block_n = 0;
     void* block_buf = nullptr;
+    void* block_ptr = nullptr; /* mutex/cond address (BLK_MUTEX/COND) */
+    uint32_t cond_gen = 0;     /* generation recorded at cond_wait */
     int64_t block_result = 0;
     bool comp_ready = false;
     std::vector<int> poll_set; /* fds a BLK_POLL thread waits on */
@@ -148,8 +204,20 @@ struct Proc {
                                              empty = v1 read-interest */
     int32_t wake_gen = 0; /* sleep/poll-timeout generation: a wake for an
                              abandoned earlier block must not fire */
+    void* (*start_fn)(void*) = nullptr; /* pthread entry */
+    void* start_arg = nullptr;
+};
 
-    std::map<int, Endpoint> fds;
+struct Proc {
+    int32_t pid = -1;
+    int32_t host = -1;
+    bool started = false;
+    bool done = false;
+    int exit_code = 0;
+
+    std::vector<GThread*> threads; /* [0] = main */
+
+    std::map<int, Endpoint> fds; /* shared by all the proc's threads */
 
     void* dl = nullptr;
     shim_main_fn entry = nullptr;
@@ -163,6 +231,7 @@ struct Runtime {
     std::vector<ShimReq> reqs;
     int64_t now_ns = 0;
     Proc* current = nullptr;
+    GThread* cur_thread = nullptr;
     long lmid = 0; /* next dlmopen namespace; -1 = exhausted, use dlopen */
     std::string err;
     /* driver-pushed DNS table (name -> virtual IPv4, host order); static
@@ -194,12 +263,14 @@ void push_req(Runtime* rt, int32_t pid, int32_t op, int32_t fd, int32_t port,
 /* suspend the calling green thread until the scheduler resumes it */
 void block_here(Runtime* rt, Proc* p, int32_t kind, int32_t fd, int64_t n,
                 void* buf) {
-    p->blocked_on = kind;
-    p->block_fd = fd;
-    p->block_n = n;
-    p->block_buf = buf;
-    p->comp_ready = false;
-    swapcontext(&p->ctx, &p->sched_ctx);
+    (void)p;
+    GThread* t = rt->cur_thread;
+    t->blocked_on = kind;
+    t->block_fd = fd;
+    t->block_n = n;
+    t->block_buf = buf;
+    t->comp_ready = false;
+    swapcontext(&t->ctx, &t->sched_ctx);
 }
 
 /* ------------------------------------------------------------------ api */
@@ -250,10 +321,15 @@ int api_accept(void* vctx, int fd) {
 int api_connect(void* vctx, int fd, const char* host, int port) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
-    if (p->fds.find(fd) == p->fds.end()) return -1;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    it->second.conn = 0;
+    it->second.connect_started = true;
     push_req(rt, p->pid, REQ_CONNECT, fd, port, 0, host);
     block_here(rt, p, BLK_CONNECT, fd, 0, nullptr);
-    return static_cast<int>(p->block_result); /* 0 ok, -1 refused */
+    it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    return it->second.conn == 1 ? 0 : -1;
 }
 
 int64_t api_send(void* vctx, int fd, const void* buf, int64_t n) {
@@ -269,6 +345,7 @@ int64_t api_send(void* vctx, int fd, const void* buf, int64_t n) {
         if (peer == p->fds.end() || peer->second.closed) return -1;
         peer->second.inbuf.append(static_cast<const char*>(buf),
                                   static_cast<size_t>(n));
+        peer->second.activity += static_cast<uint64_t>(n);
         return n;
     }
     it->second.outbuf.append(static_cast<const char*>(buf),
@@ -306,7 +383,10 @@ int api_close(void* vctx, int fd) {
     it->second.closed = true;
     if (it->second.is_pipe) {
         auto peer = p->fds.find(it->second.pipe_peer);
-        if (peer != p->fds.end()) peer->second.fin_rx = true;
+        if (peer != p->fds.end()) {
+            peer->second.fin_rx = true;
+            peer->second.activity++;
+        }
         return 0;
     }
     if (it->second.is_timer) {
@@ -323,12 +403,18 @@ int64_t api_time_ns(void* vctx) {
     return static_cast<Runtime*>(vctx)->now_ns;
 }
 
+/* wake generations ride the REQ_SLEEP `port` word with the thread id in
+ * the high bits, so a COMP_WAKE routes to the exact thread that slept */
+int32_t wake_token(GThread* t) {
+    return (t->tid << 16) | (++t->wake_gen & 0xFFFF);
+}
+
 int api_sleep_ns(void* vctx, int64_t ns) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
     if (ns <= 0) return 0;
-    push_req(rt, p->pid, REQ_SLEEP, -1, ++p->wake_gen, rt->now_ns + ns,
-             nullptr);
+    push_req(rt, p->pid, REQ_SLEEP, -1, wake_token(rt->cur_thread),
+             rt->now_ns + ns, nullptr);
     block_here(rt, p, BLK_SLEEP, -1, 0, nullptr);
     return 0;
 }
@@ -403,6 +489,7 @@ bool fd_ready(Proc* p, int fd) {
     if (it == p->fds.end()) return true; /* error -> surface immediately */
     const Endpoint& e = it->second;
     if (e.is_timer) return e.expirations > 0;
+    if (e.is_udp) return !e.udp_in.empty();
     /* a refused connect is read-ready too: POSIX reports POLLIN|POLLERR
      * and recv() errors immediately on such a socket */
     return !e.inbuf.empty() || e.fin_rx || !e.accept_queue.empty() ||
@@ -422,16 +509,17 @@ int api_poll_fds(void* vctx, const int* fds, int nfds, int64_t timeout_ns) {
     };
     int m = mask_of();
     if (m || timeout_ns == 0) return m;
-    p->poll_set.assign(fds, fds + nfds);
+    GThread* t = rt->cur_thread;
+    t->poll_set.assign(fds, fds + nfds);
     if (timeout_ns > 0) {
-        push_req(rt, p->pid, REQ_SLEEP, -1, ++p->wake_gen,
+        push_req(rt, p->pid, REQ_SLEEP, -1, wake_token(t),
                  rt->now_ns + timeout_ns, nullptr);
     }
     block_here(rt, p, BLK_POLL, -1, 0, nullptr);
     /* a timeout wake left unconsumed (poll satisfied by readiness) must
      * not fire into a later sleep/poll: retire this generation */
-    p->wake_gen++;
-    p->poll_set.clear();
+    t->wake_gen++;
+    t->poll_set.clear();
     return mask_of();
 }
 
@@ -459,7 +547,9 @@ int api_connect_ip(void* vctx, int fd, uint32_t ip, int port, int nonblock) {
              static_cast<int64_t>(ip));
     if (nonblock) return 0;
     block_here(rt, p, BLK_CONNECT, fd, 0, nullptr);
-    return static_cast<int>(p->block_result);
+    it = p->fds.find(fd);
+    if (it == p->fds.end()) return -1;
+    return it->second.conn == 1 ? 0 : -1;
 }
 
 uint32_t api_resolve(void* vctx, const char* name) {
@@ -548,16 +638,17 @@ int api_poll_many(void* vctx, const int* fds, const unsigned char* want,
     };
     int n = fill();
     if (n || timeout_ns == 0) return n;
-    p->poll_set.assign(fds, fds + nfds);
-    p->poll_want.assign(want, want + nfds);
+    GThread* t = rt->cur_thread;
+    t->poll_set.assign(fds, fds + nfds);
+    t->poll_want.assign(want, want + nfds);
     if (timeout_ns > 0) {
-        push_req(rt, p->pid, REQ_SLEEP, -1, ++p->wake_gen,
+        push_req(rt, p->pid, REQ_SLEEP, -1, wake_token(t),
                  rt->now_ns + timeout_ns, nullptr);
     }
     block_here(rt, p, BLK_POLL, -1, 0, nullptr);
-    p->wake_gen++;
-    p->poll_set.clear();
-    p->poll_want.clear();
+    t->wake_gen++;
+    t->poll_set.clear();
+    t->poll_want.clear();
     return fill();
 }
 
@@ -573,6 +664,98 @@ int api_poll2(void* vctx, const int* fds, const unsigned char* want,
     return m;
 }
 
+/* ------------------------------------------------------------ v3: UDP */
+
+int api_udp_socket(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    int fd = rt_alloc_fd(rt);
+    if (fd < 0) return -1;
+    p->fds[fd].is_udp = true;
+    return fd;
+}
+
+/* bind the datagram socket into the device stack's demux table
+ * (udp.c:26-60 association semantics); port 0 allocates an ephemeral
+ * one. Returns the bound port. Re-binding is idempotent per fd. */
+int api_udp_bind(void* vctx, int fd, int port) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.is_udp) return -1;
+    if (it->second.local_port) return it->second.local_port;
+    if (port == 0) port = rt->next_eph_port++;
+    it->second.local_port = port;
+    push_req(rt, p->pid, REQ_UDP_BIND, fd, port, 0, nullptr);
+    return port;
+}
+
+int64_t api_udp_sendto(void* vctx, int fd, uint32_t ip, int port,
+                       const void* buf, int64_t n) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.is_udp || it->second.closed ||
+        n < 0)
+        return -1;
+    Endpoint& e = it->second;
+    /* an unbound sender binds lazily (the kernel's implicit bind on
+     * first sendto) so replies can route back */
+    if (!e.local_port) {
+        e.local_port = rt->next_eph_port++;
+        push_req(rt, p->pid, REQ_UDP_BIND, fd, e.local_port, 0, nullptr);
+    }
+    int64_t seq = e.udp_seq++;
+    OutDgram& d = e.udp_out[seq];
+    d.sent_ns = rt->now_ns;
+    d.bytes.assign(static_cast<const char*>(buf), static_cast<size_t>(n));
+    push_req(rt, p->pid, REQ_SENDTO, fd, port,
+             (seq << 32) | (n & 0xFFFFFFFFLL), nullptr,
+             static_cast<int64_t>(ip));
+    return n;
+}
+
+/* blocking recvfrom: one datagram per call (message boundaries are
+ * UDP's contract; truncation past cap drops the tail like MSG_TRUNC) */
+int64_t api_udp_recvfrom(void* vctx, int fd, void* buf, int64_t cap,
+                         uint32_t* ip_out, int* port_out) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.is_udp || cap < 0) return -1;
+    while (it->second.udp_in.empty()) {
+        if (it->second.closed) return -1;
+        block_here(rt, p, BLK_RECV, fd, cap, buf);
+        it = p->fds.find(fd);
+        if (it == p->fds.end()) return -1;
+    }
+    Datagram d = std::move(it->second.udp_in.front());
+    it->second.udp_in.pop_front();
+    int64_t n = static_cast<int64_t>(d.bytes.size());
+    if (n > cap) n = cap;
+    memcpy(buf, d.bytes.data(), static_cast<size_t>(n));
+    if (ip_out) *ip_out = d.src_ip;
+    if (port_out) *port_out = d.src_port;
+    return n;
+}
+
+/* monotone inbound-activity counter for edge-triggered epoll (v5) */
+uint64_t api_fd_activity(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    return it == p->fds.end() ? 0 : it->second.activity;
+}
+
+/* pending datagram count (nonblocking probes / poll fast path) */
+int api_udp_pending(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    if (it == p->fds.end() || !it->second.is_udp) return -1;
+    return static_cast<int>(it->second.udp_in.size());
+}
+
 int api_fd_new(void* vctx) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
@@ -585,10 +768,11 @@ int api_fd_new(void* vctx) {
 void api_proc_exit(void* vctx, int code) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
+    GThread* t = rt->cur_thread;
     p->exit_code = code;
-    p->done = true;
+    p->done = true; /* exit() kills every thread of the process */
     push_req(rt, p->pid, REQ_EXIT, -1, 0, code, nullptr);
-    swapcontext(&p->ctx, &p->sched_ctx);
+    swapcontext(&t->ctx, &t->sched_ctx);
     /* unreachable: a done proc is never resumed */
 }
 
@@ -608,6 +792,125 @@ int api_current_pid(void* vctx) {
 const char* api_env_get(void* vctx, const char* name) {
     (void)vctx;
     return name ? getenv(name) : nullptr; /* base-namespace environ */
+}
+
+/* -------------------------------------------------- v4: pthread shim */
+
+void thread_trampoline();
+
+GThread* new_gthread(Proc* p) {
+    GThread* t = new GThread();
+    t->proc = p;
+    t->tid = static_cast<int32_t>(p->threads.size());
+    t->stack = static_cast<char*>(malloc(kStackSize));
+    p->threads.push_back(t);
+    return t;
+}
+
+int api_thread_create(void* vctx, void* (*fn)(void*), void* arg) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    GThread* t = new_gthread(p);
+    t->start_fn = fn;
+    t->start_arg = arg;
+    getcontext(&t->ctx);
+    t->ctx.uc_stack.ss_sp = t->stack;
+    t->ctx.uc_stack.ss_size = kStackSize;
+    t->ctx.uc_link = nullptr;
+    makecontext(&t->ctx, thread_trampoline, 0);
+    return t->tid; /* immediately runnable; runs within this pump */
+}
+
+/* last-thread-out process completion: once the MAIN thread has exited
+ * via pthread_exit, the process ends when every worker is done (POSIX
+ * process lifetime; return-from-main instead kills everything at once
+ * in proc_trampoline) */
+void maybe_finish_proc(Runtime* rt, Proc* p) {
+    if (p->done || p->threads.empty() || !p->threads[0]->done) return;
+    for (GThread* t : p->threads)
+        if (!t->done) return;
+    p->done = true;
+    push_req(rt, p->pid, REQ_EXIT, -1, 0, p->exit_code, nullptr);
+}
+
+int api_thread_join(void* vctx, int tid, void** retval) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    if (tid <= 0 || tid >= static_cast<int>(p->threads.size())) return -1;
+    if (tid == rt->cur_thread->tid) return -1; /* EDEADLK */
+    while (!p->threads[tid]->done) {
+        block_here(rt, p, BLK_JOIN, -1, tid, nullptr);
+    }
+    if (retval) *retval = p->threads[tid]->retval;
+    return 0;
+}
+
+int api_thread_self(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    return rt->cur_thread ? rt->cur_thread->tid : 0;
+}
+
+void api_thread_exit(void* vctx, void* retval) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    GThread* t = rt->cur_thread;
+    t->retval = retval;
+    t->done = true;
+    /* main thread pthread_exit: the process lives while workers run;
+     * whichever thread finishes LAST completes it */
+    maybe_finish_proc(rt, t->proc);
+    swapcontext(&t->ctx, &t->sched_ctx);
+    /* unreachable */
+}
+
+int api_mutex_lock(void* vctx, void* m) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    ShimMutex* mu = static_cast<ShimMutex*>(m);
+    while (mu->locked) {
+        GThread* t = rt->cur_thread;
+        t->block_ptr = m;
+        block_here(rt, rt->current, BLK_MUTEX, -1, 0, nullptr);
+    }
+    mu->locked = 1;
+    mu->owner_tid = rt->cur_thread->tid;
+    return 0;
+}
+
+int api_mutex_trylock(void* vctx, void* m) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    ShimMutex* mu = static_cast<ShimMutex*>(m);
+    if (mu->locked) return 16; /* EBUSY */
+    mu->locked = 1;
+    mu->owner_tid = rt->cur_thread->tid;
+    return 0;
+}
+
+int api_mutex_unlock(void* vctx, void* m) {
+    (void)vctx;
+    ShimMutex* mu = static_cast<ShimMutex*>(m);
+    mu->locked = 0;
+    mu->owner_tid = -1;
+    return 0;
+}
+
+int api_cond_wait(void* vctx, void* c, void* m) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    ShimCond* cv = static_cast<ShimCond*>(c);
+    GThread* t = rt->cur_thread;
+    t->cond_gen = cv->gen;
+    t->block_ptr = c;
+    cv->waiters++;
+    api_mutex_unlock(vctx, m);
+    block_here(rt, rt->current, BLK_COND, -1, 0, nullptr);
+    cv->waiters--;
+    /* POSIX allows spurious wakeups; every waiter wakes on a bump and
+     * recontends for the mutex, then rechecks its predicate */
+    return api_mutex_lock(vctx, m);
+}
+
+int api_cond_signal(void* vctx, void* c) {
+    (void)vctx;
+    static_cast<ShimCond*>(c)->gen++;
+    return 0;
 }
 
 ShimAPI make_api(Runtime* rt) {
@@ -643,11 +946,26 @@ ShimAPI make_api(Runtime* rt) {
     a.current_pid = api_current_pid;
     a.env_get = api_env_get;
     a.poll_many = api_poll_many;
+    a.udp_socket = api_udp_socket;
+    a.udp_bind = api_udp_bind;
+    a.udp_sendto = api_udp_sendto;
+    a.udp_recvfrom = api_udp_recvfrom;
+    a.udp_pending = api_udp_pending;
+    a.thread_create = api_thread_create;
+    a.thread_join = api_thread_join;
+    a.thread_self = api_thread_self;
+    a.thread_exit = api_thread_exit;
+    a.mutex_lock = api_mutex_lock;
+    a.mutex_trylock = api_mutex_trylock;
+    a.mutex_unlock = api_mutex_unlock;
+    a.cond_wait = api_cond_wait;
+    a.cond_signal = api_cond_signal;
+    a.fd_activity = api_fd_activity;
     return a;
 }
 
-/* trampoline: ucontext entry can't portably take pointers, so the proc is
- * handed over via the runtime's `current` */
+/* trampolines: ucontext entry can't portably take pointers, so the proc
+ * and thread are handed over via the runtime's current/cur_thread */
 void proc_trampoline() {
     Runtime* rt = g_rt;
     Proc* p = rt->current;
@@ -668,51 +986,83 @@ void proc_trampoline() {
             ff(nullptr);
         }
     }
+    /* main returning terminates the process, workers included (C11 /
+     * POSIX: return from main == exit) */
+    p->threads[0]->done = true;
     p->done = true;
     push_req(rt, p->pid, REQ_EXIT, -1, 0, p->exit_code, nullptr);
-    swapcontext(&p->ctx, &p->sched_ctx);
+    swapcontext(&p->threads[0]->ctx, &p->threads[0]->sched_ctx);
 }
 
-bool runnable(const Proc* p) {
-    if (p->done || !p->started) return false;
-    switch (p->blocked_on) {
+void thread_trampoline() {
+    Runtime* rt = g_rt;
+    GThread* t = rt->cur_thread;
+    t->retval = t->start_fn(t->start_arg);
+    t->done = true;
+    maybe_finish_proc(rt, t->proc); /* main may have pthread_exit'ed */
+    swapcontext(&t->ctx, &t->sched_ctx);
+}
+
+bool runnable_thread(Proc* p, const GThread* t) {
+    if (t->done) return false;
+    switch (t->blocked_on) {
         case BLK_NONE:
             return true;
-        case BLK_CONNECT:
-        case BLK_ACCEPT:
         case BLK_SLEEP:
-            return p->comp_ready;
-        case BLK_RECV: {
-            auto it = p->fds.find(p->block_fd);
+            return t->comp_ready;
+        case BLK_CONNECT: {
+            auto it = p->fds.find(t->block_fd);
             if (it == p->fds.end()) return true; /* error path */
+            return it->second.conn != 0; /* handshake resolved */
+        }
+        case BLK_ACCEPT: {
+            auto it = p->fds.find(t->block_fd);
+            if (it == p->fds.end()) return true;
+            return !it->second.accept_queue.empty();
+        }
+        case BLK_RECV: {
+            auto it = p->fds.find(t->block_fd);
+            if (it == p->fds.end()) return true; /* error path */
+            if (it->second.is_udp)
+                return !it->second.udp_in.empty() || it->second.closed;
             return !it->second.inbuf.empty() || it->second.fin_rx ||
                    it->second.conn == -1;
         }
         case BLK_TIMER: {
-            auto it = p->fds.find(p->block_fd);
+            auto it = p->fds.find(t->block_fd);
             if (it == p->fds.end()) return true;
             return it->second.expirations > 0;
         }
         case BLK_POLL: {
-            if (p->comp_ready) return true; /* poll timeout fired */
-            Proc* q = const_cast<Proc*>(p);
-            for (size_t i = 0; i < p->poll_set.size(); i++) {
-                unsigned char w = i < p->poll_want.size() ? p->poll_want[i]
+            if (t->comp_ready) return true; /* poll timeout fired */
+            for (size_t i = 0; i < t->poll_set.size(); i++) {
+                unsigned char w = i < t->poll_want.size() ? t->poll_want[i]
                                                           : 1;
-                if (fd_ready2(q, p->poll_set[i], w)) return true;
+                if (fd_ready2(p, t->poll_set[i], w)) return true;
             }
             return false;
         }
+        case BLK_JOIN: {
+            int tid = static_cast<int>(t->block_n);
+            return tid < static_cast<int>(p->threads.size()) &&
+                   p->threads[tid]->done;
+        }
+        case BLK_MUTEX:
+            return static_cast<ShimMutex*>(t->block_ptr)->locked == 0;
+        case BLK_COND:
+            return static_cast<ShimCond*>(t->block_ptr)->gen != t->cond_gen;
     }
     return false;
 }
 
-void resume(Runtime* rt, Proc* p) {
-    p->blocked_on = BLK_NONE;
-    p->comp_ready = false;
+void resume(Runtime* rt, Proc* p, GThread* t) {
+    t->blocked_on = BLK_NONE;
+    t->comp_ready = false;
     rt->current = p;
-    swapcontext(&p->sched_ctx, &p->ctx);
+    rt->cur_thread = t;
+    swapcontext(&t->sched_ctx, &t->ctx);
     rt->current = nullptr;
+    rt->cur_thread = nullptr;
 }
 
 } // namespace
@@ -737,7 +1087,10 @@ void shim_dns_add(void* vrt, const char* name, uint32_t ip) {
 void shim_free(void* vrt) {
     Runtime* rt = static_cast<Runtime*>(vrt);
     for (Proc* p : rt->procs) {
-        free(p->stack);
+        for (GThread* t : p->threads) {
+            free(t->stack);
+            delete t;
+        }
         if (p->dl) dlclose(p->dl);
         delete p;
     }
@@ -764,7 +1117,51 @@ int shim_spawn(void* vrt, int host_gid, const char* so_path,
         if (!p->dl) rt->lmid = -1;
     }
     if (!p->dl) {
-        p->dl = dlopen(so_path, RTLD_NOW | RTLD_LOCAL);
+        /* Namespace budget exhausted: load a PRIVATE COPY of the .so.
+         * glibc dedups loaded objects by (dev, inode), so a byte-copy at
+         * a fresh path maps a fresh object with its own globals — the
+         * elf-loader's isolated-globals guarantee
+         * (src/external/elf-loader/README:25-33) without a custom
+         * loader, scaling to hundreds of instances. The copy is
+         * unlinked immediately (the mapping keeps it alive), so nothing
+         * leaks on any exit path. */
+        char tmpl[] = "/tmp/shim_plugin_XXXXXX";
+        int tfd = mkstemp(tmpl);
+        if (tfd >= 0) {
+            int sfd = open(so_path, O_RDONLY);
+            if (sfd >= 0) {
+                char buf[1 << 16];
+                ssize_t n;
+                bool ok = true;
+                while ((n = ::read(sfd, buf, sizeof buf)) > 0) {
+                    if (::write(tfd, buf, static_cast<size_t>(n)) != n) {
+                        ok = false;
+                        break;
+                    }
+                }
+                close(sfd);
+                close(tfd);
+                if (ok) {
+                    /* DEEPBIND is load-bearing: a base-namespace dlopen
+                     * resolves the plugin's libc calls against the
+                     * GLOBAL scope (the simulator's real libc) before
+                     * the plugin's own dep chain, silently bypassing
+                     * the interposer — real sockets, a blocking accept
+                     * wedging the scheduler thread. DEEPBIND puts the
+                     * plugin's deps (interposer ahead of libc) first,
+                     * restoring the dlmopen lookup order. */
+                    p->dl = dlopen(tmpl,
+                                   RTLD_NOW | RTLD_LOCAL | RTLD_DEEPBIND);
+                }
+            } else {
+                close(tfd);
+            }
+            unlink(tmpl);
+        }
+    }
+    if (!p->dl) {
+        /* last resort: the shared-object fallback (globals shared) */
+        p->dl = dlopen(so_path, RTLD_NOW | RTLD_LOCAL | RTLD_DEEPBIND);
     }
     if (!p->dl) {
         rt->err = std::string("dlopen failed: ") + dlerror();
@@ -807,12 +1204,12 @@ int shim_spawn(void* vrt, int host_gid, const char* so_path,
     for (auto& s : p->argv_store) p->argv.push_back(s.data());
     p->argv.push_back(nullptr);
 
-    p->stack = static_cast<char*>(malloc(kStackSize));
-    getcontext(&p->ctx);
-    p->ctx.uc_stack.ss_sp = p->stack;
-    p->ctx.uc_stack.ss_size = kStackSize;
-    p->ctx.uc_link = nullptr;
-    makecontext(&p->ctx, proc_trampoline, 0);
+    GThread* t0 = new_gthread(p); /* tid 0 = the plugin's main thread */
+    getcontext(&t0->ctx);
+    t0->ctx.uc_stack.ss_sp = t0->stack;
+    t0->ctx.uc_stack.ss_size = kStackSize;
+    t0->ctx.uc_link = nullptr;
+    makecontext(&t0->ctx, proc_trampoline, 0);
 
     rt->procs.push_back(p);
     return p->pid;
@@ -835,6 +1232,24 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
     rt->now_ns = now_ns;
     rt->reqs.clear();
 
+    /* prune in-flight UDP payloads whose datagram the device dropped
+     * (reliability roll / queue overflow leaves no tombstone): anything
+     * older than 120 virtual seconds is unreachable — no simulated path
+     * holds a packet that long */
+    constexpr int64_t kUdpTtlNs = 120LL * 1000 * 1000 * 1000;
+    for (Proc* p : rt->procs) {
+        for (auto& kv : p->fds) {
+            Endpoint& e = kv.second;
+            if (!e.is_udp || e.udp_out.empty()) continue;
+            for (auto it = e.udp_out.begin(); it != e.udp_out.end();) {
+                if (now_ns - it->second.sent_ns > kUdpTtlNs)
+                    it = e.udp_out.erase(it);
+                else
+                    ++it;
+            }
+        }
+    }
+
     for (int i = 0; i < n_comps; i++) {
         const ShimComp& c = comps[i];
         if (c.pid < 0 || c.pid >= static_cast<int>(rt->procs.size()))
@@ -843,14 +1258,13 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
         switch (c.op) {
             case COMP_CONNECT_OK:
             case COMP_CONNECT_FAIL: {
-                /* endpoint state first: nonblocking connects learn the
-                 * outcome via conn_status/SO_ERROR, not a blocked thread */
+                /* endpoint state is the wake signal: blocked connects
+                 * poll e.conn via runnable_thread, nonblocking ones via
+                 * conn_status/SO_ERROR */
                 auto it = p->fds.find(c.fd);
-                if (it != p->fds.end())
+                if (it != p->fds.end()) {
                     it->second.conn = (c.op == COMP_CONNECT_OK) ? 1 : -1;
-                if (p->blocked_on == BLK_CONNECT && p->block_fd == c.fd) {
-                    p->block_result = (c.op == COMP_CONNECT_OK) ? 0 : -1;
-                    p->comp_ready = true;
+                    it->second.activity++;
                 }
                 break;
             }
@@ -858,40 +1272,54 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
                 int child = static_cast<int>(c.r0);
                 p->fds[child]; /* create the endpoint */
                 auto it = p->fds.find(c.fd);
-                if (it != p->fds.end()) it->second.accept_queue.push_back(child);
-                if (p->blocked_on == BLK_ACCEPT && p->block_fd == c.fd)
-                    p->comp_ready = true;
+                if (it != p->fds.end()) {
+                    it->second.accept_queue.push_back(child);
+                    it->second.activity++;
+                }
                 break;
             }
-            case COMP_WAKE:
-                /* r0 carries the wake generation from the REQ_SLEEP; a
+            case COMP_WAKE: {
+                /* r0 = (tid << 16) | generation from the REQ_SLEEP; a
                  * wake for an abandoned block (poll satisfied early) is
                  * stale and must not fire into a later sleep/poll */
-                if ((p->blocked_on == BLK_SLEEP || p->blocked_on == BLK_POLL)
-                    && static_cast<int32_t>(c.r0) == p->wake_gen)
-                    p->comp_ready = true;
+                int tid = static_cast<int>(c.r0) >> 16;
+                if (tid < 0 || tid >= static_cast<int>(p->threads.size()))
+                    break;
+                GThread* t = p->threads[tid];
+                if ((t->blocked_on == BLK_SLEEP || t->blocked_on == BLK_POLL)
+                    && (static_cast<int32_t>(c.r0) & 0xFFFF)
+                           == (t->wake_gen & 0xFFFF))
+                    t->comp_ready = true;
                 break;
+            }
             case COMP_TIMER: {
                 /* pad carries the arm generation; credits for a re-armed
                  * or closed timer are stale */
                 auto it = p->fds.find(c.fd);
                 if (it != p->fds.end() && it->second.is_timer
-                    && c.pad == it->second.timer_gen)
+                    && c.pad == it->second.timer_gen) {
                     it->second.expirations += c.r0;
+                    it->second.activity++;
+                }
                 break;
             }
         }
     }
 
     /* run-to-quiescence: the reference's process_continue pump
-     * (process.c:1226-1229 "pth_yield while READY|NEW threads exist") */
+     * (process.c:1226-1229 "pth_yield while READY|NEW threads exist"),
+     * now over every green thread of every virtual process */
     bool progressed = true;
     while (progressed) {
         progressed = false;
         for (Proc* p : rt->procs) {
-            if (runnable(p)) {
-                resume(rt, p);
-                progressed = true;
+            if (!p->started || p->done) continue;
+            for (size_t ti = 0; ti < p->threads.size(); ti++) {
+                GThread* t = p->threads[ti];
+                if (!p->done && runnable_thread(p, t)) {
+                    resume(rt, p, t);
+                    progressed = true;
+                }
             }
         }
     }
@@ -899,6 +1327,38 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
     int n = static_cast<int>(rt->reqs.size());
     if (n > cap) n = cap;
     memcpy(out, rt->reqs.data(), sizeof(ShimReq) * static_cast<size_t>(n));
+    return n;
+}
+
+/* Deliver one device-reported UDP datagram: move the sender's in-flight
+ * datagram `seq` into the receiver's queue, stamped with the sender's
+ * virtual address. Returns payload bytes moved, 0 if the datagram is
+ * unknown (already pruned — the delivery still "happened", the payload
+ * is gone; loud enough via the driver's accounting). */
+int64_t shim_udp_deliver(void* vrt, int src_pid, int src_fd, int64_t seq,
+                         int dst_pid, int dst_fd, uint32_t src_ip,
+                         int src_port) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (src_pid < 0 || src_pid >= static_cast<int>(rt->procs.size()))
+        return -1;
+    if (dst_pid < 0 || dst_pid >= static_cast<int>(rt->procs.size()))
+        return -1;
+    auto& sfds = rt->procs[src_pid]->fds;
+    auto& dfds = rt->procs[dst_pid]->fds;
+    auto si = sfds.find(src_fd);
+    auto di = dfds.find(dst_fd);
+    if (si == sfds.end() || di == dfds.end() || !di->second.is_udp)
+        return -1;
+    auto oi = si->second.udp_out.find(seq);
+    if (oi == si->second.udp_out.end()) return 0;
+    Datagram d;
+    d.src_ip = src_ip;
+    d.src_port = src_port;
+    d.bytes = std::move(oi->second.bytes);
+    si->second.udp_out.erase(oi);
+    int64_t n = static_cast<int64_t>(d.bytes.size());
+    di->second.udp_in.push_back(std::move(d));
+    di->second.activity += static_cast<uint64_t>(n) + 1;
     return n;
 }
 
@@ -922,6 +1382,7 @@ int64_t shim_wire_deliver(void* vrt, int src_pid, int src_fd, int dst_pid,
         di->second.inbuf.append(si->second.outbuf.data(),
                                 static_cast<size_t>(n));
         si->second.outbuf.erase(0, static_cast<size_t>(n));
+        di->second.activity += static_cast<uint64_t>(n);
     }
     return n;
 }
@@ -933,6 +1394,7 @@ int shim_wire_fin(void* vrt, int pid, int fd) {
     auto it = rt->procs[pid]->fds.find(fd);
     if (it == rt->procs[pid]->fds.end()) return -1;
     it->second.fin_rx = true;
+    it->second.activity++;
     return 0;
 }
 

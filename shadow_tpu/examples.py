@@ -49,15 +49,25 @@ def tor_example(
     filesize: str = "320KiB",
     count: int = 5,
     stoptime: int = 60,
+    relay_cpu_ghz: float = 0.0,
 ) -> str:
     """A Tor-like network config (BASELINE.md config 3 shape: minimal Tor
-    with guard/middle/exit classes plus torperf-style clients)."""
+    with guard/middle/exit classes plus torperf-style clients).
+
+    relay_cpu_ghz > 0 gives every relay a cpufrequency attribute, which
+    switches on the virtual-CPU model for relay byte handling (the
+    reference charges plugin execution time against the host CPU,
+    cpu.c:56-107; TorModel charges per-segment onion-crypto cycles)."""
+    cpu_attr = (
+        f' cpufrequency="{int(relay_cpu_ghz * 1_000_000)}"'
+        if relay_cpu_ghz > 0 else ""
+    )
     hosts = []
     for klass in ("guard", "middle", "exit"):
         for i in range(n_relays_per_class):
             hosts.append(
                 f'<host id="{klass}{i}" bandwidthup="102400" '
-                'bandwidthdown="102400">'
+                f'bandwidthdown="102400"{cpu_attr}>'
                 '<process plugin="tor" starttime="1" arguments="relay"/>'
                 "</host>"
             )

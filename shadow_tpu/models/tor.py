@@ -53,6 +53,13 @@ WEB_PORT = 80           # default server listen port
 CIRC_PORT_BASE = 20_000  # sport CIRC_PORT_BASE+cid identifies the circuit
 REQ_BYTES = 512         # one request "cell" (Tor's cell size)
 
+# Relay crypto cost: cycles a relay core spends per forwarded byte
+# (AES-CTR + digest over ~2 onion layers; public single-core relay
+# throughput of 100-300 MB/s at ~3 GHz puts this at 10-30 cycles/byte).
+# Charged per delivered segment at KIND_PKT_RX via the engine's per-kind
+# CPU table when the host has a cpufrequency (cpu.c:56-107 semantics).
+RELAY_CYCLES_PER_BYTE = 20
+
 ROLE_NONE, ROLE_RELAY, ROLE_CLIENT, ROLE_SERVER = 0, 1, 2, 3
 
 
@@ -192,6 +199,8 @@ class TorModel:
             or_port=jnp.int32(OR_PORT),
         )
 
+        self._role = role  # for the per-kind CPU table
+
         s = b.n_sockets
         state = TorApp(
             gid=jnp.arange(n, dtype=_I32),
@@ -210,6 +219,20 @@ class TorModel:
         self._stack = stack
         self._kind_fetch = kind_base
         return [self._on_fetch]
+
+    def cpu_kind_cycles(self, n_kinds: int) -> np.ndarray:
+        """Per-(host, kind) cycle charges: relays pay onion-crypto work
+        for every delivered segment (KIND_PKT_RX). Takes effect only on
+        hosts whose config sets cpufrequency — build_simulation converts
+        cycles to virtual-CPU nanoseconds there."""
+        from shadow_tpu.transport.stack import KIND_PKT_RX
+        from shadow_tpu.transport.tcp import MSS
+
+        cy = np.zeros((self._role.shape[0], n_kinds), np.int64)
+        cy[self._role == ROLE_RELAY, KIND_PKT_RX] = (
+            RELAY_CYCLES_PER_BYTE * MSS
+        )
+        return cy
 
     # ------------------------------------------------- client fetch kind
     def _on_fetch(self, hs, ev: Events, key):

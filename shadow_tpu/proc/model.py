@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from shadow_tpu.core.engine import Emit
 from shadow_tpu.core.events import Events
-from shadow_tpu.host.sockets import PROTO_TCP
+from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP, PROTO_UDP
 from shadow_tpu.transport.stack import F_FIN, N_PKT_ARGS
 from shadow_tpu.transport.tcp import LISTEN as TCP_LISTEN
 from shadow_tpu.transport.tcp import emit_concat
@@ -28,10 +28,18 @@ from shadow_tpu.transport.tcp import emit_concat
 _I32 = jnp.int32
 
 # command words (args[0] of an injected KIND_CMD event)
-CMD_LISTEN = 1   # args: [cmd, slot, port]
-CMD_CONNECT = 2  # args: [cmd, slot, sport, peer_gid, peer_port]
-CMD_SEND = 3     # args: [cmd, slot, nbytes]
-CMD_CLOSE = 4    # args: [cmd, slot]
+CMD_LISTEN = 1    # args: [cmd, slot, port]
+CMD_CONNECT = 2   # args: [cmd, slot, sport, peer_gid, peer_port]
+CMD_SEND = 3      # args: [cmd, slot, nbytes]
+CMD_CLOSE = 4     # args: [cmd, slot]
+CMD_UDP_BIND = 5  # args: [cmd, slot, port]
+CMD_SENDTO = 6    # args: [cmd, slot, dst_gid, dst_port, nbytes, seq]
+CMD_UDP_CLOSE = 7  # args: [cmd, slot]
+
+# per-host UDP delivery ring depth: bounds datagrams deliverable to one
+# host between two driver observes (one conservative window); overflow
+# is detected and raised by the driver, never silent
+UDP_RING = 64
 
 
 @jax.tree_util.register_dataclass
@@ -45,6 +53,15 @@ class ProcApp:
     # (device child-slot reuse bumps tcb.conn_gen without any driver
     # bind, so a sticky fin_seen from the previous connection must be
     # reset lazily when a new incarnation's first delivery arrives)
+    # UDP delivery ring (udp.c:26-60 immediate buffer-in, realized as
+    # per-window records the driver drains): each delivered datagram
+    # appends (src gid, src port, dst port, length, sender seq)
+    udp_cnt: jax.Array  # i32[H] total datagrams ever delivered
+    udp_src: jax.Array  # i32[H, R]
+    udp_sport: jax.Array  # i32[H, R]
+    udp_dport: jax.Array  # i32[H, R]
+    udp_len: jax.Array  # i32[H, R]
+    udp_seq: jax.Array  # i32[H, R]
 
 
 class ProcTierModel:
@@ -66,14 +83,17 @@ class ProcTierModel:
         return 1
 
     def handler_rows(self) -> int:
-        return 4  # connect(2) + send kick(1) + close kick(1)
+        return 5  # connect(2) + send kick(1) + close kick(1) + udp(1)
 
     def build(self, b):
         n = b.n_hosts
+        zr = jnp.zeros((n, UDP_RING), _I32)
         state = ProcApp(
             gid=jnp.arange(n, dtype=_I32),
             fin_seen=jnp.zeros((n, b.n_sockets), bool),
             fin_gen=jnp.zeros((n, b.n_sockets), _I32),
+            udp_cnt=jnp.zeros((n,), _I32),
+            udp_src=zr, udp_sport=zr, udp_dport=zr, udp_len=zr, udp_seq=zr,
         )
         return state, self._make_handlers, self._on_recv
 
@@ -88,15 +108,22 @@ class ProcTierModel:
         slot = jnp.maximum(ev.args[1], 0)
         is_listen = cmd == CMD_LISTEN
         is_conn = cmd == CMD_CONNECT
+        is_ubind = cmd == CMD_UDP_BIND
+        is_uclose = cmd == CMD_UDP_CLOSE
 
-        # bind the socket row in-lane (tgen's rebind idiom; host.c bind)
+        # bind the socket row in-lane (tgen's rebind idiom; host.c bind;
+        # UDP association per udp.c:26-60 — bind installs the demux row,
+        # close clears it)
         sk = hs.net.sockets
-        do_bind = is_listen | is_conn
-        port = ev.args[2]  # listen port / connect source port
+        do_bind = is_listen | is_conn | is_ubind | is_uclose
+        port = jnp.where(is_uclose, 0, ev.args[2])
+        proto = jnp.where(
+            is_ubind, PROTO_UDP, jnp.where(is_uclose, PROTO_NONE, PROTO_TCP)
+        )
         w = lambda a, v: a.at[slot].set(jnp.where(do_bind, v, a[slot]))
         sk = dataclasses.replace(
             sk,
-            proto=w(sk.proto, PROTO_TCP),
+            proto=w(sk.proto, proto),
             local_port=w(sk.local_port, port),
             peer_host=w(sk.peer_host, jnp.where(is_conn, ev.args[3], -1)),
             peer_port=w(sk.peer_port, jnp.where(is_conn, ev.args[4], 0)),
@@ -120,13 +147,34 @@ class ProcTierModel:
             hs, slot, ev.args[2], ev.time, mask=cmd == CMD_SEND
         )
         hs, em_close = tcp.close(hs, slot, ev.time, mask=cmd == CMD_CLOSE)
-        return hs, emit_concat(em_conn, em_send, em_close)
+        hs, em_udp = stack.send_udp(
+            hs, ev.time, slot, ev.args[2], ev.args[3], ev.args[4],
+            aux=ev.args[5], mask=cmd == CMD_SENDTO,
+        )
+        return hs, emit_concat(em_conn, em_send, em_close, em_udp)
 
     def _on_recv(self, hs, slot, pkt, now, key):
         got = slot >= 0
-        eof = got & ((pkt.flags & F_FIN) != 0)
-        s = jnp.maximum(slot, 0)
         app = hs.app
+
+        # UDP datagram: append a delivery record to the ring the driver
+        # drains each window (payload bytes move host-side by seq)
+        is_udp = got & (pkt.proto == PROTO_UDP)
+        idx = jnp.where(is_udp, app.udp_cnt % UDP_RING, 0)
+        wr = lambda a, v: a.at[idx].set(jnp.where(is_udp, v, a[idx]))
+        app = dataclasses.replace(
+            app,
+            udp_cnt=app.udp_cnt + is_udp.astype(_I32),
+            udp_src=wr(app.udp_src, pkt.src_host),
+            udp_sport=wr(app.udp_sport, pkt.src_port),
+            udp_dport=wr(app.udp_dport, pkt.dst_port),
+            udp_len=wr(app.udp_len, pkt.length),
+            udp_seq=wr(app.udp_seq, pkt.aux),
+        )
+        hs = dataclasses.replace(hs, app=app)
+
+        eof = got & ~is_udp & ((pkt.flags & F_FIN) != 0)
+        s = jnp.maximum(slot, 0)
         # lazy per-incarnation reset: if this slot's TCB was reused since
         # fin_seen was last written, the sticky EOF belongs to a previous
         # connection and must clear before this delivery is applied

@@ -19,6 +19,7 @@ _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
 REQ_LISTEN, REQ_CONNECT, REQ_SEND, REQ_CLOSE = 1, 2, 3, 4
 REQ_SLEEP, REQ_EXIT, REQ_LOG, REQ_TIMER = 5, 6, 7, 8
+REQ_UDP_BIND, REQ_SENDTO = 9, 10
 COMP_CONNECT_OK, COMP_CONNECT_FAIL, COMP_ACCEPT, COMP_WAKE = 1, 2, 3, 4
 COMP_TIMER = 5
 
@@ -109,7 +110,7 @@ def compile_posix_plugin(
         return out
     cc = "g++" if source.endswith(("cc", "cpp")) else "gcc"
     cmd = [
-        cc, "-O1", "-fPIC", "-shared", "-o", out, source,
+        cc, "-O1", "-fPIC", "-shared", "-D_GNU_SOURCE", "-o", out, source,
         "-I", os.path.join(_INTERPOSE_DIR, "compat"),
         *sum([["-I", d] for d in (include_dirs or [])], []),
         "-L", _BUILD_DIR, "-lshadow_interpose",
@@ -165,6 +166,11 @@ class ShimRuntime:
         lib.shim_wire_fin.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
         ]
+        lib.shim_udp_deliver.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
+        ]
+        lib.shim_udp_deliver.restype = ctypes.c_int64
         lib.shim_proc_exit_code.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
         ]
@@ -217,6 +223,16 @@ class ShimRuntime:
 
     def wire_fin(self, pid, fd) -> None:
         self._lib.shim_wire_fin(self._rt, pid, fd)
+
+    def udp_deliver(self, src_pid, src_fd, seq, dst_pid, dst_fd,
+                    src_ip, src_port) -> int:
+        """Move one device-delivered datagram's payload from the sender's
+        in-flight pool to the receiver's queue (source address stamped
+        for recvfrom)."""
+        return int(self._lib.shim_udp_deliver(
+            self._rt, src_pid, src_fd, seq, dst_pid, dst_fd, src_ip,
+            src_port,
+        ))
 
     def dns_add(self, name: str, ip: int) -> None:
         """Push one name -> virtual-IPv4 (host order) mapping for the

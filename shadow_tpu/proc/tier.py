@@ -47,6 +47,10 @@ from shadow_tpu.proc.model import (
     CMD_CONNECT,
     CMD_LISTEN,
     CMD_SEND,
+    CMD_SENDTO,
+    CMD_UDP_BIND,
+    CMD_UDP_CLOSE,
+    UDP_RING,
     ProcTierModel,
 )
 from shadow_tpu.proc.native import (
@@ -61,8 +65,10 @@ from shadow_tpu.proc.native import (
     COMP_TIMER,
     REQ_LOG,
     REQ_SEND,
+    REQ_SENDTO,
     REQ_SLEEP,
     REQ_TIMER,
+    REQ_UDP_BIND,
     ShimRuntime,
 )
 from shadow_tpu.sim import build_simulation
@@ -167,7 +173,20 @@ class ProcessTier:
                         self._stops, (int(p.stoptime * SECOND), pid)
                     )
 
+        # UDP endpoint bookkeeping (udp.c:26-60 association realized as
+        # driver maps): (pid, fd) -> (gid, slot, port) for runtime
+        # endpoints, the (gid, port) demux index for routing delivery
+        # records back to senders and receivers, and each host's
+        # virtual IP for recvfrom addresses
+        self.udp_eps: dict[tuple[int, int], tuple[int, int, int]] = {}
+        self.udp_port: dict[tuple[int, int], tuple[int, int]] = {}
+        self._udp_used = False
+        self._gid_ip: dict[int, int] = {
+            a.host_id: a.ip for a in self.sim.dns.entries()
+        }
+
         h_n = len(self.sim.names)
+        self._prev_udp_cnt = np.zeros((h_n,), np.int32)
         self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
         self._prev_fin = np.zeros((h_n, n_sockets), bool)
         # vectorized-observe state: endpoint membership, per-slot owed
@@ -314,9 +333,37 @@ class ProcessTier:
                 if key in self.slot_of:
                     gid, slot = self.slot_of[key]
                     rows.append((gid, [CMD_SEND, slot, int(r.a0)]))
+            elif r.op == REQ_UDP_BIND:
+                self._udp_used = True
+                slot = self._alloc_slot(gid)
+                self.udp_eps[(pid, fd)] = (gid, slot, int(r.port))
+                self.udp_port[(gid, int(r.port))] = (pid, fd)
+                rows.append((gid, [CMD_UDP_BIND, slot, int(r.port)]))
+            elif r.op == REQ_SENDTO:
+                ep = self.udp_eps.get((pid, fd))
+                if ep is None:
+                    continue  # closed underneath the sender
+                seq = int(r.a0) >> 32
+                nbytes = int(r.a0) & 0xFFFFFFFF
+                ip = int(r.a1)
+                # wildcard/loopback route to the sending host itself
+                if ip in (0, 0x7F000001):
+                    dst_gid = gid
+                else:
+                    addr = self.sim.dns.resolve_ip(ip)
+                    if addr is None:
+                        continue  # unroutable: the datagram just drops
+                    dst_gid = addr.host_id
+                rows.append((gid, [CMD_SENDTO, ep[1], dst_gid,
+                                   int(r.port), nbytes, seq]))
             elif r.op == REQ_CLOSE:
                 key = (pid, fd)
-                if key in self.slot_of:
+                if key in self.udp_eps:
+                    gid, slot, port = self.udp_eps.pop(key)
+                    self.udp_port.pop((gid, port), None)
+                    self._free_slots.setdefault(gid, []).append(slot)
+                    rows.append((gid, [CMD_UDP_CLOSE, slot]))
+                elif key in self.slot_of:
                     gid, slot = self.slot_of[key]
                     rows.append((gid, [CMD_CLOSE, slot]))
             elif r.op == REQ_SLEEP:
@@ -387,12 +434,50 @@ class ProcessTier:
         # reused slot must not read as this stream's EOF
         fin = fin_raw & (fgen == cgen)
 
+        # UDP delivery ring: move each newly-recorded datagram's payload
+        # from its sender's in-flight pool to the receiver's queue
+        # (fetched only once a datagram socket exists — pure-TCP runs
+        # pay nothing)
+        if self._udp_used:
+            app = st.hosts.app
+            ucnt, usrc, usport, udport, _ulen, useq = (
+                np.asarray(x) for x in jax.device_get((
+                    app.udp_cnt, app.udp_src, app.udp_sport,
+                    app.udp_dport, app.udp_len, app.udp_seq,
+                ))
+            )
+            for g in np.nonzero(ucnt != self._prev_udp_cnt)[0]:
+                g = int(g)
+                lo, hi = int(self._prev_udp_cnt[g]), int(ucnt[g])
+                if hi - lo > UDP_RING:
+                    raise RuntimeError(
+                        f"host {g}: {hi - lo} UDP datagrams delivered in "
+                        f"one window overran the {UDP_RING}-slot ring; "
+                        "deliveries were lost"
+                    )
+                for i in range(lo, hi):
+                    k = i % UDP_RING
+                    dst_ep = self.udp_port.get((g, int(udport[g, k])))
+                    src_ep = self.udp_port.get(
+                        (int(usrc[g, k]), int(usport[g, k]))
+                    )
+                    if dst_ep is None or src_ep is None:
+                        continue  # endpoint closed while in flight
+                    self.rt.udp_deliver(
+                        src_ep[0], src_ep[1], int(useq[g, k]),
+                        dst_ep[0], dst_ep[1],
+                        self._gid_ip.get(int(usrc[g, k]), 0),
+                        int(usport[g, k]),
+                    )
+            self._prev_udp_cnt = ucnt.copy()
+
         # accumulate this window's delivered-byte deltas FIRST (against
         # the pre-drop _known mask): bytes that land in the same window
         # an endpoint's slot turns over must reach the drop-time flush,
         # not vanish with the _known clear
         self._undeliv += np.where(self._known,
                                   np.maximum(rx - self._prev_rx, 0), 0)
+        prev_rx = self._prev_rx  # pre-update snapshot for step 2 below
         self._prev_rx = rx
 
         # 0. slot incarnation changed under a live endpoint: the device
@@ -429,6 +514,17 @@ class ProcessTier:
             lpid, lfd = lp
             nfd = self._alloc_fd(lpid)
             self._register_ep(gid, slot, lpid, nfd, driver_owned=False)
+            # under loss the handshake's final ACK can arrive in the same
+            # window as the first data burst: the child is ESTABLISHED
+            # with rx_bytes already advanced, but the delta pass above ran
+            # before this endpoint was _known. Everything delivered since
+            # the last window is owed. rx_bytes is a cumulative lifetime
+            # counter (never reset on slot reuse), so the baseline is the
+            # pre-update snapshot — the previous incarnation's final
+            # count — not zero.
+            self._undeliv[gid, slot] = max(
+                int(rx[gid, slot]) - int(prev_rx[gid, slot]), 0
+            )
             self._wire_try_pair(gid, slot, int(lport[gid, slot]),
                                 int(phost[gid, slot]),
                                 int(pport[gid, slot]))
@@ -493,6 +589,13 @@ class ProcessTier:
                 for (pfd_pid, fd), (gid, slot) in list(self.slot_of.items()):
                     if pfd_pid == pid:
                         stop_rows.append((gid, [CMD_CLOSE, slot]))
+                # and its datagram sockets (no handshake to run down:
+                # free the slot and clear the demux row immediately)
+                for key in [k for k in self.udp_eps if k[0] == pid]:
+                    gid, slot, port = self.udp_eps.pop(key)
+                    self.udp_port.pop((gid, port), None)
+                    self._free_slots.setdefault(gid, []).append(slot)
+                    stop_rows.append((gid, [CMD_UDP_CLOSE, slot]))
             if stop_rows:
                 st = self._inject(st, stop_rows, now)
             while self._wakes and self._wakes[0][0] <= now:

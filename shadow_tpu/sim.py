@@ -440,6 +440,7 @@ def build_simulation(
     bw_up = np.zeros((n_hosts,), np.float64)
     bw_down = np.zeros((n_hosts,), np.float64)
     cpu_cost = np.zeros((n_hosts,), np.int64)
+    cpu_khz = np.zeros((n_hosts,), np.int64)  # for per-kind model charges
     rcv_wnd_bytes = np.zeros((n_hosts,), np.int64)
     # NIC receive buffer bound (interfacebuffer host attr; reference
     # default 1024000 bytes, options.c:78 — CoDel acts long before a
@@ -459,6 +460,7 @@ def build_simulation(
         # accepted-and-ignored them, silently changing results)
         if s.cpufrequency:
             cpu_cost[h.gid] = CPU_CYCLES_PER_EVENT * 1_000_000 // s.cpufrequency
+            cpu_khz[h.gid] = s.cpufrequency
         if s.socketrecvbuffer:
             rcv_wnd_bytes[h.gid] = s.socketrecvbuffer
         if s.socketsendbuffer:
@@ -653,9 +655,24 @@ def build_simulation(
         axis_name=axis_name, n_shards=n_shards,
     )
     network = topo.build_network(host_vertex)
+    # per-KIND CPU charges: a model may declare cycle costs for specific
+    # event kinds (e.g. Tor relay crypto per delivered segment); they
+    # convert to virtual-CPU ns on hosts with a cpufrequency and stack on
+    # the uniform per-event cost (the reference charges measured plugin
+    # time per task, cpu.c:56-107 — per-kind tables are the jitted analog)
+    cost_arg = cpu_cost
+    if hasattr(model, "cpu_kind_cycles"):
+        cycles = model.cpu_kind_cycles(len(handlers))
+        if cycles is not None and cpu_khz.any():
+            extra_ns = np.where(
+                cpu_khz[:, None] > 0,
+                cycles * 1_000_000 // np.maximum(cpu_khz[:, None], 1),
+                0,
+            )
+            cost_arg = cpu_cost[:, None] + extra_ns
     eng = Engine(
         ecfg, handlers, network,
-        cpu_cost=jnp.asarray(cpu_cost) if cpu_cost.any() else None,
+        cpu_cost=jnp.asarray(cost_arg) if cost_arg.any() else None,
     )
 
     # -- initial events: process starts (slave.c:296-336 scheduling of

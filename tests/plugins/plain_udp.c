@@ -1,0 +1,94 @@
+/* plain_udp.c — UNMODIFIED POSIX datagram pair for the interposer tier.
+ *
+ * The same dual-role shape as the reference's src/test/udp/test_udp.c
+ * (SOCK_DGRAM socket, bind, sendto with an explicit address, recvfrom
+ * returning the source address) but exercising a CROSS-HOST path with
+ * multiple datagrams and a reply, so both the device UDP routing and
+ * the source-address stamping are load-bearing.
+ *
+ * argv: server <port> <count>
+ *       client <server-name> <port> <count>
+ * Exit 0 = every datagram arrived intact, in order, with a correct
+ * source address on the reply path.
+ */
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static void fill(char* buf, int n, int tag) {
+    for (int i = 0; i < n; i++) buf[i] = (char)((i * 7 + tag) & 0xFF);
+}
+
+static int check(const char* buf, int n, int tag) {
+    for (int i = 0; i < n; i++)
+        if (buf[i] != (char)((i * 7 + tag) & 0xFF)) return 0;
+    return 1;
+}
+
+static int run_server(int port, int count) {
+    int s = socket(AF_INET, SOCK_DGRAM, 0);
+    if (s < 0) return 10;
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_ANY);
+    a.sin_port = htons((uint16_t)port);
+    if (bind(s, (struct sockaddr*)&a, sizeof a) < 0) return 11;
+    char buf[2048];
+    for (int i = 0; i < count; i++) {
+        struct sockaddr_in from = {0};
+        socklen_t flen = sizeof from;
+        ssize_t n = recvfrom(s, buf, sizeof buf, 0,
+                             (struct sockaddr*)&from, &flen);
+        if (n != 1000 + i) return 12;
+        if (!check(buf, (int)n, i)) return 13;
+        /* echo back to the datagram's source address */
+        fill(buf, (int)n, i + 100);
+        if (sendto(s, buf, (size_t)n, 0, (struct sockaddr*)&from, flen)
+            != n)
+            return 14;
+    }
+    printf("PLAIN_UDP_SERVER_OK %d\n", count);
+    return 0;
+}
+
+static int run_client(const char* host, int port, int count) {
+    int s = socket(AF_INET, SOCK_DGRAM, 0);
+    if (s < 0) return 20;
+    struct addrinfo hints = {0}, *ai = 0;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_DGRAM;
+    char ps[16];
+    snprintf(ps, sizeof ps, "%d", port);
+    if (getaddrinfo(host, ps, &hints, &ai) != 0 || !ai) return 21;
+    char buf[2048];
+    for (int i = 0; i < count; i++) {
+        int n = 1000 + i;
+        fill(buf, n, i);
+        if (sendto(s, buf, (size_t)n, 0, ai->ai_addr, ai->ai_addrlen)
+            != n)
+            return 22;
+        struct sockaddr_in from = {0};
+        socklen_t flen = sizeof from;
+        ssize_t got = recvfrom(s, buf, sizeof buf, 0,
+                               (struct sockaddr*)&from, &flen);
+        if (got != n) return 23;
+        if (!check(buf, (int)got, i + 100)) return 24;
+        if (ntohs(from.sin_port) != port) return 25; /* reply source */
+    }
+    freeaddrinfo(ai);
+    printf("PLAIN_UDP_CLIENT_OK %d\n", count);
+    return 0;
+}
+
+int main(int argc, char** argv) {
+    if (argc >= 4 && strcmp(argv[1], "server") == 0)
+        return run_server(atoi(argv[2]), atoi(argv[3]));
+    if (argc >= 5 && strcmp(argv[1], "client") == 0)
+        return run_client(argv[2], atoi(argv[3]), atoi(argv[4]));
+    return 2;
+}

@@ -60,7 +60,7 @@ def pair_config(plugin_path: str, mode: str, nbytes: int) -> str:
       </host>
       <host id="client0">
         <process plugin="plain_tcp" starttime="2"
-          arguments="{mode} client server0 8080 40000"/>
+          arguments="{mode} client server0 8080 {nbytes}"/>
       </host>
     </shadow>""")
 
@@ -89,6 +89,33 @@ def test_unmodified_posix_echo(plugin, mode, capfd):
     # payload bytes really crossed the simulated network both directions
     rx = int(st.hosts.net.sockets.rx_bytes.sum())
     assert rx >= 2 * 40_000
+    out = capfd.readouterr().out
+    assert "PLAIN_TCP_OK 40000" in out
+    tier.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "mode",
+    ["blocking", "nonblocking-poll", "nonblocking-epoll",
+     "nonblocking-select"],
+)
+def test_unmodified_posix_echo_lossy(plugin, mode, capfd):
+    """The reference's LOSSY leg of the io-mode matrix
+    (src/test/tcp/CMakeLists.txt:14-60): 10% packet loss on the only
+    edge, so establishment, data, and FIN all ride retransmissions; the
+    unmodified POSIX endpoints must still verify every byte."""
+    from shadow_tpu.proc import ProcessTier
+
+    lossy = TOPO.replace(
+        '<data key="d4">0.0</data>', '<data key="d4">0.1</data>'
+    )
+    cfg = parse_config(
+        pair_config(plugin, mode, 40_000).replace(TOPO, lossy)
+    )
+    tier = ProcessTier(cfg, seed=11)
+    tier.run()
+    assert tier.exit_codes == {0: 0, 1: 0}, (mode, tier.exit_codes)
     out = capfd.readouterr().out
     assert "PLAIN_TCP_OK 40000" in out
     tier.close()

