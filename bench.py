@@ -321,6 +321,19 @@ def phold_worker():
     print(json.dumps(r))
 
 
+def phold_big_worker():
+    """PHOLD at 16384 hosts (the BASELINE north star's >=10k-host scale
+    on one chip): 4x the primary's host count at the same per-host
+    message population. events/s rises with host count (more parallel
+    lanes amortize the per-sweep sort); sim-s/wall-s falls because event
+    density per sim-second scales with hosts. Both are reported."""
+    stop_s = min(int(os.environ.get("BENCH_STOP_S", STOP_SIM_SECONDS)), 20)
+    global N_HOSTS
+    N_HOSTS = 16384
+    r = tpu_rate(stop_s, capacity=64)
+    print(json.dumps({f"phold16k_{k}": v for k, v in r.items()}))
+
+
 def skew_worker():
     stop_s = min(int(os.environ.get("BENCH_STOP_S", STOP_SIM_SECONDS)), 10)
     # hot-spot variant: 1.5% of hosts receive 30% of traffic (the skewed
@@ -334,6 +347,7 @@ def main():
     for flag, fn in (("--tor-worker", tor_worker),
                      ("--btc-worker", btc_worker),
                      ("--phold-worker", phold_worker),
+                     ("--phold-big-worker", phold_big_worker),
                      ("--skew-worker", skew_worker)):
         if flag in sys.argv:
             fn()
@@ -426,6 +440,10 @@ def main():
     rb = run_secondary("--btc-worker")
     if rb:
         out.update(rb)
+        print(json.dumps(out), flush=True)
+    rbig = run_secondary("--phold-big-worker")
+    if rbig:
+        out.update(rbig)
         print(json.dumps(out), flush=True)
     rs = run_secondary("--skew-worker")
     if rs:

@@ -200,6 +200,8 @@ static void vfd_free(int vfd) {
     memset(v, 0, sizeof(*v));
 }
 
+static void sig_reset_all(void);
+
 static void vfd_reset_all(void) {
     for (int p = 0; p < g_npp; p++) {
         for (int i = 0; i < g_pp[p].len; i++) free(g_pp[p].tab[i].watch);
@@ -208,6 +210,7 @@ static void vfd_reset_all(void) {
     free(g_pp);
     g_pp = 0;
     g_npp = 0;
+    sig_reset_all();
 }
 
 /* ----------------------------------------------------------- sockets */
@@ -1136,6 +1139,19 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
      * blocking wait so they can neither wake it nor be re-reported. */
     int count = 0;
     for (int pass = 0; pass < 2; pass++) {
+        /* re-drop watches whose fd closed while pass 0's blocking wait
+         * yielded to sibling green threads (a pthread plugin may
+         * close() a watched fd from another thread; Linux auto-removes
+         * it, and a stale slot here would deref NULL) */
+        for (int i = 0; i < n;) {
+            if (!vfd_get(e->watch[i].vfd)) {
+                e->watch[i] = e->watch[--e->n_watch];
+                n = e->n_watch;
+            } else {
+                i++;
+            }
+        }
+        if (n == 0) break;
         int n_armed = 0;
         for (int i = 0; i < n; i++) {
             rfds[i] = vfd_get(e->watch[i].vfd)->rfd;
@@ -1258,6 +1274,16 @@ typedef struct SigProc {
 static SigProc* g_sig = 0;
 static int g_nsig = 0;
 static unsigned char g_sig_installed[SIG_TABLE_MAX];
+
+/* runtime change (shared interposer copy serving successive
+ * simulations): the previous runtime's handler pointers aim into
+ * dlclose()d plugin copies — drop them. The real trampolines live in
+ * THIS interposer copy and stay valid, so g_sig_installed persists. */
+static void sig_reset_all(void) {
+    free(g_sig);
+    g_sig = 0;
+    g_nsig = 0;
+}
 
 REAL(int, sigaction, (int, const struct sigaction*, struct sigaction*))
 

@@ -99,7 +99,7 @@ def test_tracker_heartbeats_parse_and_reconcile():
 def test_cli_emits_parseable_heartbeats(capsys):
     from shadow_tpu.cli import main
 
-    rc = main(["--test", "--stoptime", "30", "--heartbeat-frequency", "10"])
+    rc = main(["--test", "--stoptime", "20", "--heartbeat-frequency", "10"])
     assert rc == 0
     out = capsys.readouterr().out
     stats = parse_lines(out.splitlines())

@@ -79,7 +79,9 @@ def test_edges_and_order_group_pairs():
 
 def test_locality_halves_cross_shard_packets():
     mesh = make_mesh(8)
-    cfg_text = pair_config(16)  # 32 hosts, 4 per shard
+    # 8 pairs over 8 shards: smallest shape where naive interleaving
+    # still straddles shards while locality packs each pair onto one
+    cfg_text = pair_config(8)  # 16 hosts, 2 per shard
 
     crosses, totals = [], {}
     for locality in (False, True):

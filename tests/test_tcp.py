@@ -129,11 +129,13 @@ def test_bulk_transfer_lossless_full_close():
 
 
 def test_bulk_transfer_lossy_recovers_all_bytes():
-    eng, st = build(reliability=0.85, seed=11)
-    st = jax.jit(eng.run)(st, jnp.int64(30 * SECOND))
+    # 50 KB over ~20 sim-s exercises the same retransmit/ssthresh paths
+    # as the original 100 KB/30 s at half the (single-core CI) runtime
+    eng, st = build(total=50_000, reliability=0.85, seed=11)
+    st = jax.jit(eng.run)(st, jnp.int64(20 * SECOND))
     tcb = st.hosts.net.tcb
     # 15% loss: every byte still arrives, via retransmissions
-    assert int(st.hosts.app.rx[1]) == 100_000
+    assert int(st.hosts.app.rx[1]) == 50_000
     assert int(tcb.n_retx[0, 0]) > 0
     # congestion controller reacted: ssthresh came down from its initial
     assert float(tcb.ssthresh[0, 0]) < tcpm.INIT_SSTHRESH
