@@ -1138,19 +1138,23 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
      * between two waits). Disarmed watches are excluded from the
      * blocking wait so they can neither wake it nor be re-reported. */
     int count = 0;
+    const int n_alloc = n; /* rfds/want/ready were sized for this many */
     for (int pass = 0; pass < 2; pass++) {
         /* re-drop watches whose fd closed while pass 0's blocking wait
          * yielded to sibling green threads (a pthread plugin may
          * close() a watched fd from another thread; Linux auto-removes
-         * it, and a stale slot here would deref NULL) */
-        for (int i = 0; i < n;) {
+         * it, and a stale slot here would deref NULL). A sibling may
+         * also have ADDED watches; those wait for the next epoll_wait —
+         * the scratch buffers were sized at entry, never scan past
+         * that. */
+        for (int i = 0; i < e->n_watch;) {
             if (!vfd_get(e->watch[i].vfd)) {
                 e->watch[i] = e->watch[--e->n_watch];
-                n = e->n_watch;
             } else {
                 i++;
             }
         }
+        n = e->n_watch < n_alloc ? e->n_watch : n_alloc;
         if (n == 0) break;
         int n_armed = 0;
         for (int i = 0; i < n; i++) {
@@ -1480,6 +1484,28 @@ int pthread_attr_setdetachstate(pthread_attr_t* a, int state) {
 }
 
 /* -------------------------------------------------------------- process */
+
+pid_t fork(void) {
+    /* unsupported, reported loudly (the reference's fork path likewise
+     * fails under its green-thread runtime — process_emu_fork ->
+     * pth_fork errors out; real fork would duplicate the whole
+     * simulator). EAGAIN is the POSIX resource-limit answer. */
+    errno = EAGAIN;
+    return -1;
+}
+
+pid_t vfork(void) {
+    errno = EAGAIN;
+    return -1;
+}
+
+pid_t getpid(void) {
+    /* virtual pid, distinct per process (the reference reports emulated
+     * ids too — plugins must not see the simulator's real pid) */
+    return A ? (pid_t)(1000 + A->current_pid(A->ctx)) : 1;
+}
+
+pid_t getppid(void) { return 1; }
 
 void exit(int code) {
     if (A) {

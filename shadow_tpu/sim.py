@@ -442,6 +442,7 @@ def build_simulation(
     cpu_cost = np.zeros((n_hosts,), np.int64)
     cpu_khz = np.zeros((n_hosts,), np.int64)  # for per-kind model charges
     rcv_wnd_bytes = np.zeros((n_hosts,), np.int64)
+    snd_buf_bytes = np.zeros((n_hosts,), np.int64)  # 0 = unlimited
     # NIC receive buffer bound (interfacebuffer host attr; reference
     # default 1024000 bytes, options.c:78 — CoDel acts long before a
     # megabyte of standing queue, so the default only bounds pathology)
@@ -464,11 +465,11 @@ def build_simulation(
         if s.socketrecvbuffer:
             rcv_wnd_bytes[h.gid] = s.socketrecvbuffer
         if s.socketsendbuffer:
-            raise ValueError(
-                f"host {h.name!r}: socketsendbuffer is not implemented for "
-                "jitted app models (they cannot block on a full send "
-                "buffer); remove the attribute"
-            )
+            # bounded send buffer: bytes beyond the cap wait in the
+            # TCB's app_pending and drain on ACK progress — the jitted
+            # analog of the reference's blocking send against its
+            # (autotuned) buffer, tcp.c:407-598
+            snd_buf_bytes[h.gid] = s.socketsendbuffer
         if s.interfacebuffer:
             rx_buf[h.gid] = s.interfacebuffer
         if s.logpcap or s.pcapdir:
@@ -497,6 +498,13 @@ def build_simulation(
     else:
         parts = resolve_app_models(cfg, registry, hosts)
         model = parts[0][1] if len(parts) == 1 else FusedModel(parts)
+    if snd_buf_bytes.any() and not model.needs_tcp:
+        # semantics-bearing attrs act or fail loudly: without a TCP
+        # stack there is no send buffer for the cap to bound
+        raise ValueError(
+            "socketsendbuffer is set but the app model "
+            f"{model.name!r} runs no TCP stack; remove the attribute"
+        )
     if capacity is None:
         # every in-flight packet occupies a destination queue slot, so a
         # TCP host must hold a full receive window (64*WND_WORDS segs)
@@ -510,6 +518,7 @@ def build_simulation(
         rcv_wnd_bytes=rcv_wnd_bytes if rcv_wnd_bytes.any() else None,
         wnd_words=tcp_wnd_words,
         rx_buf_bytes=jnp.asarray(rx_buf),
+        snd_buf_bytes=snd_buf_bytes if snd_buf_bytes.any() else None,
     )
     if pcap_mask.any():
         from shadow_tpu.utils.pcap import CaptureRing
@@ -534,7 +543,11 @@ def build_simulation(
     # virtual clock — round-robin at packet granularity. 'fifo' (default)
     # keeps burst transmission; admission follows the event total order,
     # which *is* packet-creation order (the reference's FIFO qdisc sorts
-    # on a host-monotonic creation counter, packet.c:87-88).
+    # on a host-monotonic creation counter, packet.c:87-88; its single
+    # exception — control packets stamped priority 0.0 to jump the
+    # queue, tcp.c:844 — is immaterial here because pure ACKs ride
+    # their own events through the same total order rather than a
+    # shared tx backlog).
     if qdisc not in ("fifo", "rr"):
         raise ValueError(f"unknown qdisc {qdisc!r}")
     tcp_kw = dict(tx_burst=1, inline_budget=1) if qdisc == "rr" else {}

@@ -139,7 +139,8 @@ class HostNet:
     @staticmethod
     def create(n_hosts: int, n_sockets: int, bw_up_kib, bw_down_kib,
                with_tcp: bool = False, rcv_wnd_bytes=None,
-               wnd_words: int | None = None, rx_buf_bytes=0) -> "HostNet":
+               wnd_words: int | None = None, rx_buf_bytes=0,
+               snd_buf_bytes=None) -> "HostNet":
         up = jnp.broadcast_to(jnp.asarray(bw_up_kib), (n_hosts,))
         down = jnp.broadcast_to(jnp.asarray(bw_down_kib), (n_hosts,))
         tcb = None
@@ -158,7 +159,8 @@ class HostNet:
                     rb > 0, jnp.clip(rb // MSS, 1, cap_max), cap_max
                 ).astype(jnp.int32)
             tcb = TCB.create(
-                n_hosts, n_sockets, rcv_wnd=rcv_wnd, wnd_words=ww
+                n_hosts, n_sockets, rcv_wnd=rcv_wnd, wnd_words=ww,
+                snd_cap=snd_buf_bytes,
             )
         return HostNet(
             nic_tx=NIC.create(up),

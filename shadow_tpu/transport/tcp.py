@@ -179,14 +179,26 @@ class TCB:
     cc_epoch: jax.Array  # i64 cubic epoch start (0 = unset)
     conn_gen: jax.Array  # i32 slot incarnation (stale-delack rejection)
     sacked: jax.Array  # u64 SACK scoreboard: bit i = snd_una+i received
+    # bounded send buffer (socketsendbuffer; tcp.c:407-598 autotune
+    # family): snd_cap caps unacked bytes held in snd_buf (0 =
+    # unlimited); app bytes beyond it wait in app_pending and drain as
+    # ACKs free space — the jitted analog of a blocking send()
+    snd_cap: jax.Array  # i64 bytes (0 = unlimited)
+    app_pending: jax.Array  # i64 app bytes waiting for buffer space
 
     @staticmethod
     def create(n_hosts: int, n_sockets: int, rcv_wnd=None,
-               wnd_words: int = WND_WORDS) -> "TCB":
+               wnd_words: int = WND_WORDS, snd_cap=None) -> "TCB":
         s = (n_hosts, n_sockets)
         zi = jnp.zeros(s, _I32)
         zl = jnp.zeros(s, _I64)
         zb = jnp.zeros(s, bool)
+        if snd_cap is None:
+            cap_snd = zl
+        else:
+            cap_snd = jnp.broadcast_to(
+                jnp.asarray(snd_cap, _I64)[:, None], s
+            )
         cap_max = 64 * wnd_words
         if rcv_wnd is None:
             cap = jnp.full(s, cap_max, _I32)
@@ -228,6 +240,8 @@ class TCB:
             cc_epoch=zl,
             conn_gen=zi,
             sacked=jnp.zeros(s, jnp.uint64),
+            snd_cap=cap_snd,
+            app_pending=zl,
         )
 
     def listen(self, host: int, slot: int) -> "TCB":
@@ -287,6 +301,8 @@ def _fresh_row_like(old: TCB) -> TCB:
         cc_epoch=jnp.int64(0),
         conn_gen=old.conn_gen + 1,
         sacked=jnp.uint64(0),
+        snd_cap=old.snd_cap,
+        app_pending=jnp.int64(0),
     )
 
 
@@ -294,15 +310,23 @@ def _n_segs(snd_buf):
     return ((snd_buf + MSS - 1) // MSS).astype(_I32)
 
 
+def _fin_ready(row) -> jax.Array:
+    """The FIN may only take its sequence slot once every app byte —
+    including bytes still waiting behind the send-buffer cap — is in
+    snd_buf; otherwise drained bytes would land past the FIN's seq."""
+    return row.fin_pending & (row.app_pending == 0)
+
+
 def _outstanding(row) -> jax.Array:
     """True while the connection still needs timer coverage: unacked
     flight, queued-but-unsent data or FIN, or a handshake in progress.
     (A timer that dies with work pending strands the connection if the
     last in-flight packet is lost.)"""
-    lim = _n_segs(row.snd_buf) + row.fin_pending.astype(_I32)
+    lim = _n_segs(row.snd_buf) + _fin_ready(row).astype(_I32)
     return (
         (row.snd_nxt > row.snd_una)
         | ((row.snd_una < lim) & (row.state >= ESTABLISHED))
+        | (row.app_pending > 0)
         | (row.state == SYN_SENT)
         | (row.state == SYN_RCVD)
     )
@@ -601,7 +625,8 @@ class TCP:
         Returns (nic_tx', row', rows, more). State moves to FIN_WAIT_1 /
         LAST_ACK when the FIN goes out (tcp.c _tcp_flush semantics)."""
         n_segs = _n_segs(row.snd_buf)
-        lim = n_segs + row.fin_pending.astype(_I32)
+        fin_rdy = _fin_ready(row)
+        lim = n_segs + fin_rdy.astype(_I32)
         # closing states stay sendable so a post-timeout go-back-N window
         # (snd_nxt rewound below old flight) can refill with a full cwnd
         # instead of one segment per RTO
@@ -617,7 +642,7 @@ class TCP:
         for _ in range(budget):
             s = nxt
             is_data = s < n_segs
-            is_fin = row.fin_pending & ~is_data & (s == n_segs)
+            is_fin = fin_rdy & ~is_data & (s == n_segs)
             inwin = (s < row.snd_una + win) & (s < lim)
             # SACK scoreboard: a segment the receiver already holds is
             # skipped (nxt advances without a wire packet) — the whole
@@ -723,12 +748,28 @@ class TCP:
         c = jnp.maximum(jnp.asarray(slot, _I32), 0)
         mask = jnp.asarray(mask, bool) & (jnp.asarray(slot, _I32) >= 0)
         row = _row(net.tcb, c)
+        # bounded send buffer: only `room` bytes enter snd_buf now; the
+        # rest wait in app_pending and drain as ACKs free space (the
+        # jitted analog of the reference's blocking send against its
+        # autotuned buffer, tcp.c:407-598)
+        nb = jnp.asarray(nbytes, _I64)
+        acked_b = jnp.minimum(row.snd_una.astype(_I64) * MSS, row.snd_buf)
+        room = jnp.where(
+            row.snd_cap > 0,
+            jnp.maximum(row.snd_cap - (row.snd_buf - acked_b), 0),
+            nb,
+        )
+        accept = jnp.minimum(nb, room)
         boundary = (row.snd_buf // MSS).astype(_I32)
-        rewind = ((row.snd_buf % MSS) != 0) & (row.snd_nxt > boundary)
+        rewind = (
+            (accept > 0) & ((row.snd_buf % MSS) != 0)
+            & (row.snd_nxt > boundary)
+        )
         snd_nxt = jnp.where(rewind, boundary, row.snd_nxt)
         row = dataclasses.replace(
             row,
-            snd_buf=row.snd_buf + jnp.asarray(nbytes, _I64),
+            snd_buf=row.snd_buf + accept,
+            app_pending=row.app_pending + (nb - accept),
             snd_nxt=snd_nxt,
             snd_una=jnp.minimum(row.snd_una, snd_nxt),
         )
@@ -853,7 +894,7 @@ class TCP:
         # after a timeout's go-back-N rewind, acks for segments beyond the
         # rewound snd_nxt are still legitimate and must heal the window
         ack = jnp.clip(
-            pkt.ack, 0, _n_segs(row.snd_buf) + row.fin_pending.astype(_I32)
+            pkt.ack, 0, _n_segs(row.snd_buf) + _fin_ready(row).astype(_I32)
         )
         advanced = ack_ok & (ack > row.snd_una)
         n_acked = jnp.where(advanced, ack - row.snd_una, 0)
@@ -937,7 +978,7 @@ class TCP:
         )
         row = dataclasses.replace(row, sacked=sacked)
         n_segs = _n_segs(row.snd_buf)
-        fin_acked = row.fin_pending & (snd_una >= n_segs + 1)
+        fin_acked = _fin_ready(row) & (snd_una >= n_segs + 1)
         state2 = jnp.where(
             (row.state == FIN_WAIT_1) & fin_acked, FIN_WAIT_2,
             jnp.where(
@@ -965,6 +1006,32 @@ class TCP:
             srtt=srtt, rttvar=rttvar, rto=rto,
             rto_deadline=jnp.where(advanced, now + rto, row.rto_deadline),
             n_retx=row.n_retx + retx.astype(_I32),
+        )
+        # send-buffer drain: ACK progress freed space — admit waiting
+        # app bytes (the unblocking edge of the reference's blocking
+        # send), with the same partial-segment rewind tcp.send applies
+        acked_b2 = jnp.minimum(row.snd_una.astype(_I64) * MSS, row.snd_buf)
+        room2 = jnp.where(
+            row.snd_cap > 0,
+            jnp.maximum(row.snd_cap - (row.snd_buf - acked_b2), 0),
+            row.app_pending,
+        )
+        take = jnp.where(
+            advanced & (row.app_pending > 0),
+            jnp.minimum(row.app_pending, room2), jnp.int64(0),
+        )
+        d_boundary = (row.snd_buf // MSS).astype(_I32)
+        d_rewind = (
+            (take > 0) & ((row.snd_buf % MSS) != 0)
+            & (row.snd_nxt > d_boundary)
+        )
+        d_nxt = jnp.where(d_rewind, d_boundary, row.snd_nxt)
+        row = dataclasses.replace(
+            row,
+            snd_buf=row.snd_buf + take,
+            app_pending=row.app_pending - take,
+            snd_nxt=d_nxt,
+            snd_una=jnp.minimum(row.snd_una, d_nxt),
         )
 
         # -- data / FIN receive: bitmap reassembly + cumulative advance
@@ -1127,7 +1194,7 @@ class TCP:
         peer_h = sockets.peer_host[c]
         peer_p = sockets.peer_port[c]
         sport = sockets.local_port[c]
-        retx_fin = row.fin_pending & (row.snd_una == n_segs)
+        retx_fin = _fin_ready(row) & (row.snd_una == n_segs)
         nic_tx, retx_row = self._seg_row(
             nic_tx, row, now, peer_h, sport, peer_p, row.snd_una, retx_fin,
             retx & (row.snd_una < row.snd_nxt), unlimited, is_retx=True,
@@ -1332,7 +1399,7 @@ class TCP:
         is_synack_rtx = timeout & (row.state == SYN_RCVD)
         is_data_rtx = timeout & (row.state >= ESTABLISHED)
         n_segs = _n_segs(row.snd_buf)
-        retx_fin = row.fin_pending & (row.snd_una == n_segs)
+        retx_fin = _fin_ready(row) & (row.snd_una == n_segs)
         nic_tx, data_row = self._seg_row(
             net.nic_tx, row, now, peer_h, sport, peer_p, row.snd_una,
             retx_fin, is_data_rtx, unlimited, is_retx=True,

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from shadow_tpu.config import parse_config
+from shadow_tpu.core.timebase import SECOND
 from shadow_tpu.sim import build_simulation
 
 
@@ -103,12 +104,50 @@ def test_process_stoptime_stops_emissions():
     assert 0 < b < a // 2, (a, b)
 
 
-def test_unimplemented_attrs_hard_error():
-    # jitted app models cannot block on a full send buffer, so the knob
-    # must reject rather than silently not limit anything
-    xml = phold_cfg(host_extra='socketsendbuffer="1048576"')
-    with pytest.raises(ValueError, match="socketsendbuffer"):
-        build_simulation(parse_config(xml))
+def test_socketsendbuffer_bounds_and_still_delivers():
+    """socketsendbuffer (tcp.c:407-598 buffer family): app bytes beyond
+    the cap wait in the TCB's app_pending and drain as ACKs free space,
+    so a transfer far larger than the buffer still completes — the
+    jitted analog of the reference's blocking send. Round-3 hard-errored
+    this attribute; now it acts."""
+    import textwrap as tw
+
+    def cfg(extra=""):
+        return tw.dedent(f"""\
+        <shadow stoptime="60">
+          <topology><![CDATA[{topo()}]]></topology>
+          <plugin id="tgen" path="tgen"/>
+          <host id="server">
+            <process plugin="tgen" starttime="1"
+              arguments="server port=8888"/>
+          </host>
+          <host id="client"{extra}>
+            <process plugin="tgen" starttime="2"
+              arguments="peers=server:8888 sendsize=300KiB recvsize=1KiB
+              count=1"/>
+          </host>
+        </shadow>""")
+
+    # a 16 KiB cap on a 300 KiB send: the cap is ~1/20th of the payload
+    sim = build_simulation(
+        parse_config(cfg(' socketsendbuffer="16384"')), seed=3
+    )
+    # the cap is actually installed in the TCB
+    assert int(sim.state0.hosts.net.tcb.snd_cap.max()) == 16384
+    # mid-run: TGen issues the whole 300 KiB in one send at t~2s and
+    # the cap drains ~16 KiB per RTT, so just after the send most bytes
+    # must be waiting BEHIND the cap (a no-op knob would show zero
+    # pending here)
+    st = sim.run(int(2.2 * SECOND))
+    assert int(st.hosts.net.tcb.app_pending.sum()) > 100 * 1024
+    st = sim.run(state=st)
+    rx = int(st.hosts.net.sockets.rx_bytes.sum())
+    assert rx >= 300 * 1024, rx  # every byte still arrived
+    # ...and the pending queue fully drained by completion
+    assert int(st.hosts.net.tcb.app_pending.sum()) == 0
+    # and the capped run matches the uncapped run's delivered bytes
+    st_u = build_simulation(parse_config(cfg()), seed=3).run()
+    assert int(st_u.hosts.net.sockets.rx_bytes.sum()) == rx
 
 
 def test_interfacebuffer_bounds_receive_queue():
