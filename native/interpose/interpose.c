@@ -45,6 +45,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/ioctl.h>
 #include <sys/msg.h>
 #include <sys/select.h>
 #include <sys/socket.h>
@@ -96,6 +97,7 @@ REAL(int, close, (int))
 REAL(ssize_t, msgrcv, (int, void*, size_t, long, int))
 REAL(int, msgsnd, (int, const void*, size_t, int))
 REAL(int, fcntl, (int, int, ...))
+REAL(int, ioctl, (int, unsigned long, ...))
 
 /* -------------------------------------------------- per-process vfds */
 
@@ -117,12 +119,36 @@ typedef struct Vfd {
     unsigned char is_timer;
     unsigned char is_udp;
     unsigned char connect_started;
+    /* SO_SNDBUF/SO_RCVBUF mirror (tcp.c:407-598 buffer family): a
+     * user set disables autotune for that direction, exactly the
+     * reference's userDisabledSend/Receive flags. Sizes grow with
+     * traffic while autotuning — an interposer-side approximation of
+     * the device stack's rwnd autotune, documented as such. */
+    unsigned char no_autotune_snd;
+    unsigned char no_autotune_rcv;
+    unsigned int snd_size;
+    unsigned int rcv_size;
     int rfd; /* runtime fd; -1 for interposer-local (epoll) */
     uint32_t peer_ip;  /* UDP connect(2) default destination */
     int peer_port;
     int n_watch, cap_watch;
     EpollWatch* watch;
 } Vfd;
+
+/* the reference's configured defaults (definitions.h:109-159) */
+#define DFLT_SNDBUF 131072u
+#define DFLT_RCVBUF 174760u
+#define MAX_AUTOBUF (16u << 20)
+
+static void autotune_grow(Vfd* v, int is_send) {
+    if (is_send) {
+        if (!v->no_autotune_snd && v->snd_size < MAX_AUTOBUF)
+            v->snd_size += v->snd_size / 4;
+    } else {
+        if (!v->no_autotune_rcv && v->rcv_size < MAX_AUTOBUF)
+            v->rcv_size += v->rcv_size / 4;
+    }
+}
 
 typedef struct PerProc {
     Vfd* tab; /* indexed vfd - VFD_BASE */
@@ -189,6 +215,8 @@ static int vfd_alloc(int rfd) {
     memset(&p->tab[idx], 0, sizeof(Vfd));
     p->tab[idx].used = 1;
     p->tab[idx].rfd = rfd;
+    p->tab[idx].snd_size = DFLT_SNDBUF;
+    p->tab[idx].rcv_size = DFLT_RCVBUF;
     if (idx == p->next) p->next++;
     return VFD_BASE + idx;
 }
@@ -409,6 +437,7 @@ ssize_t send(int fd, const void* buf, size_t n, int flags) {
         errno = EPIPE;
         return -1;
     }
+    if (rv > 0) autotune_grow(v, 1);
     return (ssize_t)rv;
 }
 
@@ -462,6 +491,7 @@ ssize_t recv(int fd, void* buf, size_t cap, int flags) {
         errno = EBADF;
         return -1;
     }
+    if (rv > 0) autotune_grow(v, 0);
     return (ssize_t)rv;
 }
 
@@ -636,16 +666,29 @@ int getpeername(int fd, struct sockaddr* addr, socklen_t* addrlen) {
 
 int setsockopt(int fd, int level, int optname, const void* optval,
                socklen_t optlen) {
-    (void)level;
-    (void)optname;
-    (void)optval;
-    (void)optlen;
-    if (!vfd_get(fd)) {
+    Vfd* v = vfd_get(fd);
+    if (!v) {
         errno = EBADF;
         return -1;
     }
-    /* accepted and ignored: buffer/Nagle knobs are modeled by the device
-     * TCP (autotuned windows, the tcp.c:407-598 analog) */
+    if (level == SOL_SOCKET && optval && optlen >= sizeof(int)) {
+        /* Linux doubles the requested size for bookkeeping overhead;
+         * the reference's tests assert exactly that (test_sockbuf.c
+         * set-then-get == 2x). A user set disables autotune for the
+         * direction (tcp.c userDisabledSend/Receive). */
+        if (optname == SO_SNDBUF) {
+            v->snd_size = 2u * (unsigned int)(*(const int*)optval);
+            v->no_autotune_snd = 1;
+            return 0;
+        }
+        if (optname == SO_RCVBUF) {
+            v->rcv_size = 2u * (unsigned int)(*(const int*)optval);
+            v->no_autotune_rcv = 1;
+            return 0;
+        }
+    }
+    /* other knobs (Nagle etc.) accepted and ignored: modeled by the
+     * device TCP */
     return 0;
 }
 
@@ -663,11 +706,49 @@ int getsockopt(int fd, int level, int optname, void* optval,
         *optlen = sizeof(int);
         return 0;
     }
+    if (level == SOL_SOCKET && optval && optlen &&
+        *optlen >= sizeof(int)) {
+        if (optname == SO_SNDBUF) {
+            *(unsigned int*)optval = v->snd_size;
+            *optlen = sizeof(int);
+            return 0;
+        }
+        if (optname == SO_RCVBUF) {
+            *(unsigned int*)optval = v->rcv_size;
+            *optlen = sizeof(int);
+            return 0;
+        }
+    }
     if (optval && optlen && *optlen >= sizeof(int)) {
         *(int*)optval = 0;
         *optlen = sizeof(int);
     }
     return 0;
+}
+
+int ioctl(int fd, unsigned long request, ...) {
+    va_list ap;
+    va_start(ap, request);
+    void* argp = va_arg(ap, void*);
+    va_end(ap);
+    Vfd* v = vfd_get(fd);
+    if (!v) return get_real_ioctl()(fd, request, argp); /* tty/file fds */
+    /* FIONREAD == SIOCINQ; TIOCOUTQ == SIOCOUTQ (sockbuf test's queue
+     * probes — the reference emulates both from its buffer lengths) */
+    if (request == FIONREAD) {
+        if (argp) *(int*)argp = (int)A->readable_n(A->ctx, v->rfd);
+        return 0;
+    }
+    if (request == TIOCOUTQ) {
+        if (argp) *(int*)argp = (int)A->fd_outq(A->ctx, v->rfd);
+        return 0;
+    }
+    if (request == FIONBIO) {
+        v->nonblock = argp && *(int*)argp ? 1 : 0;
+        return 0;
+    }
+    errno = EINVAL;
+    return -1;
 }
 
 int fcntl(int fd, int cmd, ...) {

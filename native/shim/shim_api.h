@@ -175,6 +175,10 @@ typedef struct ShimAPI {
      * compares it across waits so a ready-fall-then-rise between two
      * waits still reads as a fresh edge. ---- */
     uint64_t (*fd_activity)(void* ctx, int fd);
+
+    /* ---- v6: outbound bytes not yet delivered by the simulated
+     * network (ioctl SIOCOUTQ; SIOCINQ is readable_n). ---- */
+    int64_t (*fd_outq)(void* ctx, int fd);
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

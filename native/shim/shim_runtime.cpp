@@ -739,6 +739,16 @@ int64_t api_udp_recvfrom(void* vctx, int fd, void* buf, int64_t cap,
     return n;
 }
 
+/* outbound not-yet-delivered bytes (SIOCOUTQ; v6) */
+int64_t api_fd_outq(void* vctx, int fd) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    auto it = p->fds.find(fd);
+    return it == p->fds.end()
+               ? -1
+               : static_cast<int64_t>(it->second.outbuf.size());
+}
+
 /* monotone inbound-activity counter for edge-triggered epoll (v5) */
 uint64_t api_fd_activity(void* vctx, int fd) {
     Runtime* rt = static_cast<Runtime*>(vctx);
@@ -961,6 +971,7 @@ ShimAPI make_api(Runtime* rt) {
     a.cond_wait = api_cond_wait;
     a.cond_signal = api_cond_signal;
     a.fd_activity = api_fd_activity;
+    a.fd_outq = api_fd_outq;
     return a;
 }
 

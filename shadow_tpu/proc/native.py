@@ -87,7 +87,9 @@ def build_interposer() -> str:
 
 
 def compile_posix_plugin(
-    source: str, name: str | None = None, include_dirs: list[str] | None = None
+    source: str, name: str | None = None,
+    include_dirs: list[str] | None = None,
+    extra_sources: list[str] | None = None,
 ) -> str:
     """Compile an UNMODIFIED POSIX source (ordinary `main`, plain libc
     socket/poll/epoll/select calls) into a simulator plugin.
@@ -103,14 +105,15 @@ def compile_posix_plugin(
     interposer = build_interposer()
     base = name or os.path.splitext(os.path.basename(source))[0]
     out = os.path.join(_BUILD_DIR, f"lib{base}.so")
-    deps = [source, interposer]
+    srcs = [source] + list(extra_sources or [])
+    deps = srcs + [interposer]
     if os.path.exists(out) and all(
         os.path.getmtime(out) >= os.path.getmtime(s) for s in deps
     ):
         return out
     cc = "g++" if source.endswith(("cc", "cpp")) else "gcc"
     cmd = [
-        cc, "-O1", "-fPIC", "-shared", "-D_GNU_SOURCE", "-o", out, source,
+        cc, "-O1", "-fPIC", "-shared", "-D_GNU_SOURCE", "-o", out, *srcs,
         "-I", os.path.join(_INTERPOSE_DIR, "compat"),
         *sum([["-I", d] for d in (include_dirs or [])], []),
         "-L", _BUILD_DIR, "-lshadow_interpose",

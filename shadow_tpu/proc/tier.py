@@ -123,6 +123,7 @@ class ProcessTier:
         self.slot_of: dict[tuple[int, int], tuple[int, int]] = {}
         self.ep_of: dict[tuple[int, int], tuple[int, int]] = {}
         self.listen_ep: dict[tuple[int, int], tuple[int, int]] = {}
+        self._listen_of_ep: dict[tuple[int, int], tuple[int, int]] = {}
         self.pending_conn: dict[tuple[int, int], tuple[int, int]] = {}
         self.wire: dict[tuple[int, int], tuple[int, int]] = {}  # slot<->slot
         # full-4-tuple wire index: (gid, lport, peer_gid, pport) -> (gid,
@@ -304,11 +305,19 @@ class ProcessTier:
                 slot = self._alloc_slot(gid)
                 self._register_ep(gid, slot, pid, fd, driver_owned=True)
                 self.listen_ep[(gid, int(r.port))] = (pid, fd)
+                self._listen_of_ep[(pid, fd)] = (gid, int(r.port))
                 rows.append((gid, [CMD_LISTEN, slot, int(r.port)]))
             elif r.op == REQ_CONNECT:
                 name = r.name.decode()
                 if name:
                     addr = self.sim.dns.resolve_name(name)
+                elif int(r.a1) in (0, 0x7F000001):
+                    # wildcard/loopback: this host (the reference's
+                    # single-process tests connect to INADDR_LOOPBACK;
+                    # the device routes it over the topology self-loop)
+                    addr = self.sim.dns.resolve_name(
+                        self.sim.names[gid]
+                    )
                 else:
                     # interposer form: a1 carries the virtual IPv4 from
                     # connect(sockaddr_in) (host order)
@@ -358,7 +367,25 @@ class ProcessTier:
                                    int(r.port), nbytes, seq]))
             elif r.op == REQ_CLOSE:
                 key = (pid, fd)
-                if key in self.udp_eps:
+                if key in self._listen_of_ep:
+                    # a closed listener has no handshake to run down:
+                    # recycle its slot NOW so a close-then-listen pair
+                    # arriving in one pump (the reference's sequential
+                    # test programs do this) never exhausts the band;
+                    # the device resets the row at the window open
+                    gp = self._listen_of_ep.pop(key)
+                    self.listen_ep.pop(gp, None)
+                    if key in self.slot_of:
+                        gid, slot = self.slot_of[key]
+                        rows.append((gid, [CMD_CLOSE, slot]))
+                        self._drop_ep(gid, slot, recycle=True)
+                        # pre-acknowledge the conn_gen bump the device's
+                        # listener reset will apply at the window open:
+                        # without this, a re-listen that reuses the slot
+                        # in this same pump would read the bump as ITS
+                        # OWN turnover and be torn down by observe
+                        self._prev_gen[gid, slot] += 1
+                elif key in self.udp_eps:
                     gid, slot, port = self.udp_eps.pop(key)
                     self.udp_port.pop((gid, port), None)
                     self._free_slots.setdefault(gid, []).append(slot)

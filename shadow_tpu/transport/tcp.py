@@ -310,6 +310,34 @@ def _n_segs(snd_buf):
     return ((snd_buf + MSS - 1) // MSS).astype(_I32)
 
 
+def _send_room(row, unlimited_default) -> jax.Array:
+    """Free send-buffer bytes under snd_cap (0 = unlimited -> the
+    caller's default), counting only unacked bytes as occupancy."""
+    acked = jnp.minimum(row.snd_una.astype(_I64) * MSS, row.snd_buf)
+    return jnp.where(
+        row.snd_cap > 0,
+        jnp.maximum(row.snd_cap - (row.snd_buf - acked), 0),
+        unlimited_default,
+    )
+
+
+def _admit_bytes(row, add):
+    """Admit `add` app bytes into snd_buf with the partial-segment
+    rewind: a transmitted partial tail segment retransmits with its
+    grown payload (module docstring)."""
+    boundary = (row.snd_buf // MSS).astype(_I32)
+    rewind = (
+        (add > 0) & ((row.snd_buf % MSS) != 0) & (row.snd_nxt > boundary)
+    )
+    nxt = jnp.where(rewind, boundary, row.snd_nxt)
+    return dataclasses.replace(
+        row,
+        snd_buf=row.snd_buf + add,
+        snd_nxt=nxt,
+        snd_una=jnp.minimum(row.snd_una, nxt),
+    )
+
+
 def _fin_ready(row) -> jax.Array:
     """The FIN may only take its sequence slot once every app byte —
     including bytes still waiting behind the send-buffer cap — is in
@@ -753,25 +781,10 @@ class TCP:
         # jitted analog of the reference's blocking send against its
         # autotuned buffer, tcp.c:407-598)
         nb = jnp.asarray(nbytes, _I64)
-        acked_b = jnp.minimum(row.snd_una.astype(_I64) * MSS, row.snd_buf)
-        room = jnp.where(
-            row.snd_cap > 0,
-            jnp.maximum(row.snd_cap - (row.snd_buf - acked_b), 0),
-            nb,
-        )
-        accept = jnp.minimum(nb, room)
-        boundary = (row.snd_buf // MSS).astype(_I32)
-        rewind = (
-            (accept > 0) & ((row.snd_buf % MSS) != 0)
-            & (row.snd_nxt > boundary)
-        )
-        snd_nxt = jnp.where(rewind, boundary, row.snd_nxt)
+        accept = jnp.minimum(nb, _send_room(row, nb))
+        row = _admit_bytes(row, accept)
         row = dataclasses.replace(
-            row,
-            snd_buf=row.snd_buf + accept,
-            app_pending=row.app_pending + (nb - accept),
-            snd_nxt=snd_nxt,
-            snd_una=jnp.minimum(row.snd_una, snd_nxt),
+            row, app_pending=row.app_pending + (nb - accept)
         )
         tcb = _write_row(net.tcb, c, row, mask)
         sockets = net.sockets.add_tx(jnp.where(mask, c, -1), nbytes)
@@ -782,16 +795,32 @@ class TCP:
 
     def close(self, hs, slot, now, mask=True):
         """Half-close after pending data (tcp.c CLOSED->FIN path): the FIN
-        is sent once everything queued has gone out."""
+        is sent once everything queued has gone out. Closing a LISTEN
+        socket has no handshake to run down — the slot resets (and its
+        conn_gen bumps so drivers observe the turnover) immediately."""
         net = hs.net
         c = jnp.maximum(jnp.asarray(slot, _I32), 0)
         mask = jnp.asarray(mask, bool) & (jnp.asarray(slot, _I32) >= 0)
-        fp = net.tcb.fin_pending.at[c].set(
-            jnp.where(mask, True, net.tcb.fin_pending[c])
+        row = _row(net.tcb, c)
+        lst = mask & (row.state == LISTEN)
+        tcb = _write_row(net.tcb, c, _fresh_row_like(row), lst)
+        fp = tcb.fin_pending.at[c].set(
+            jnp.where(mask & ~lst, True, tcb.fin_pending[c])
         )
-        tcb = dataclasses.replace(net.tcb, fin_pending=fp)
-        hs = dataclasses.replace(hs, net=dataclasses.replace(net, tcb=tcb))
-        return hs, _emit_from_rows([self._kick_row(c, now, now, mask)])
+        tcb = dataclasses.replace(tcb, fin_pending=fp)
+        # the listener's demux row clears too, so a later bind of the
+        # same port cannot alias two socket rows
+        sk = net.sockets
+        w = lambda a, v: a.at[c].set(jnp.where(lst, v, a[c]))
+        sk = dataclasses.replace(
+            sk, proto=w(sk.proto, 0), local_port=w(sk.local_port, 0)
+        )
+        hs = dataclasses.replace(
+            hs, net=dataclasses.replace(net, tcb=tcb, sockets=sk)
+        )
+        return hs, _emit_from_rows(
+            [self._kick_row(c, now, now, mask & ~lst)]
+        )
 
     # ------------------------------------------------- segment processing
     def process_segment(self, stack, hs, slot, pkt: Pkt, ev, key, on_recv):
@@ -1010,28 +1039,14 @@ class TCP:
         # send-buffer drain: ACK progress freed space — admit waiting
         # app bytes (the unblocking edge of the reference's blocking
         # send), with the same partial-segment rewind tcp.send applies
-        acked_b2 = jnp.minimum(row.snd_una.astype(_I64) * MSS, row.snd_buf)
-        room2 = jnp.where(
-            row.snd_cap > 0,
-            jnp.maximum(row.snd_cap - (row.snd_buf - acked_b2), 0),
-            row.app_pending,
-        )
         take = jnp.where(
             advanced & (row.app_pending > 0),
-            jnp.minimum(row.app_pending, room2), jnp.int64(0),
+            jnp.minimum(row.app_pending, _send_room(row, row.app_pending)),
+            jnp.int64(0),
         )
-        d_boundary = (row.snd_buf // MSS).astype(_I32)
-        d_rewind = (
-            (take > 0) & ((row.snd_buf % MSS) != 0)
-            & (row.snd_nxt > d_boundary)
-        )
-        d_nxt = jnp.where(d_rewind, d_boundary, row.snd_nxt)
+        row = _admit_bytes(row, take)
         row = dataclasses.replace(
-            row,
-            snd_buf=row.snd_buf + take,
-            app_pending=row.app_pending - take,
-            snd_nxt=d_nxt,
-            snd_una=jnp.minimum(row.snd_una, d_nxt),
+            row, app_pending=row.app_pending - take
         )
 
         # -- data / FIN receive: bitmap reassembly + cumulative advance

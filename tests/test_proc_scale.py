@@ -310,3 +310,104 @@ def test_isolated_globals_beyond_namespace_budget(capfd):
     }
     tier.close()
     os.remove(src)
+
+
+def test_close_then_relisten_same_pump(capfd):
+    """A listener closed and re-opened back-to-back (no blocking call
+    between) recycles its driver slot within ONE pump; the fresh
+    listener must then accept normally — the close-then-listen pattern
+    every sequential reference test program uses, and the race a
+    premature slot turnover would corrupt."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    src = os.path.join(REPO, "native/plugins/_t_relisten.c")
+    with open(src, "w") as f:
+        f.write(textwrap.dedent("""\
+        #include <netinet/in.h>
+        #include <stdio.h>
+        #include <string.h>
+        #include <sys/socket.h>
+        #include <unistd.h>
+
+        static int mklisten(int port) {
+            int s = socket(AF_INET, SOCK_STREAM, 0);
+            struct sockaddr_in a = {0};
+            a.sin_family = AF_INET;
+            a.sin_port = htons((unsigned short)port);
+            if (bind(s, (struct sockaddr*)&a, sizeof a) != 0) return -1;
+            if (listen(s, 8) != 0) return -1;
+            return s;
+        }
+
+        int main(int argc, char** argv) {
+            if (argc > 1 && strcmp(argv[1], "client") == 0) {
+                struct sockaddr_in a = {0};
+                a.sin_family = AF_INET;
+                a.sin_addr.s_addr = htonl((10u<<24)|1);  /* resolved below */
+                return 0;
+            }
+            /* three close-then-relisten cycles with NO blocking call in
+             * between: all six requests land in one pump */
+            int l = -1;
+            for (int i = 0; i < 3; i++) {
+                if (l >= 0) close(l);
+                l = mklisten(7070);
+                if (l < 0) return 10;
+            }
+            int c = accept(l, 0, 0); /* the echo peer connects */
+            if (c < 0) return 11;
+            char buf[8] = {0};
+            if (recv(c, buf, sizeof buf, 0) != 5) return 12;
+            if (strcmp(buf, "ping") != 0) return 13;
+            if (send(c, "pong", 5, 0) != 5) return 14;
+            printf("RELISTEN_OK\\n");
+            return 0;
+        }
+        """))
+    plug = compile_posix_plugin(src, name="_t_relisten")
+    peer_src = os.path.join(REPO, "native/plugins/_t_relisten_peer.c")
+    with open(peer_src, "w") as f:
+        f.write(textwrap.dedent("""\
+        #include <netdb.h>
+        #include <netinet/in.h>
+        #include <stdio.h>
+        #include <string.h>
+        #include <sys/socket.h>
+        #include <unistd.h>
+
+        int main(void) {
+            struct addrinfo h = {0}, *ai = 0;
+            h.ai_family = AF_INET;
+            h.ai_socktype = SOCK_STREAM;
+            if (getaddrinfo("srv", "7070", &h, &ai) != 0) return 20;
+            int s = socket(AF_INET, SOCK_STREAM, 0);
+            if (connect(s, ai->ai_addr, ai->ai_addrlen) != 0) return 21;
+            if (send(s, "ping", 5, 0) != 5) return 22;
+            char buf[8] = {0};
+            if (recv(s, buf, sizeof buf, 0) != 5) return 23;
+            if (strcmp(buf, "pong") != 0) return 24;
+            printf("RELISTEN_PEER_OK\\n");
+            return 0;
+        }
+        """))
+    peer = compile_posix_plugin(peer_src, name="_t_relisten_peer")
+    cfg = parse_config(
+        f'<shadow stoptime="30">'
+        f"<topology><![CDATA[{TOPO}]]></topology>"
+        f'<plugin id="_t_relisten" path="{plug}"/>'
+        f'<plugin id="_t_relisten_peer" path="{peer}"/>'
+        f'<host id="srv"><process plugin="_t_relisten" starttime="1" '
+        f'arguments=""/></host>'
+        f'<host id="cli"><process plugin="_t_relisten_peer" starttime="2" '
+        f'arguments=""/></host>'
+        f"</shadow>"
+    )
+    tier = ProcessTier(cfg, seed=8)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0, 1: 0}, (tier.exit_codes, out[-1500:])
+    assert "RELISTEN_OK" in out and "RELISTEN_PEER_OK" in out
+    tier.close()
+    os.remove(src)
+    os.remove(peer_src)

@@ -84,6 +84,39 @@ def test_reference_test_signal_unmodified(capfd):
     tier.close()
 
 
+def test_reference_test_sockbuf_unmodified(capfd):
+    """src/test/sockbuf/test_sockbuf.c (+ its test_common.c helper,
+    compiled together): SO_SNDBUF/SO_RCVBUF get/set with the Linux 2x
+    rule, user-set sizes disabling autotune, autotuned sizes growing
+    across a transfer, SIOCINQ/SIOCOUTQ queue probes, and a
+    single-process listener/client/child trio over loopback."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    src = "/root/reference/src/test/sockbuf/test_sockbuf.c"
+    if not os.path.exists(src):
+        pytest.skip("reference tree not mounted")
+    plug = compile_posix_plugin(
+        src, name="ref_test_sockbuf",
+        extra_sources=["/root/reference/src/test/test_common.c"],
+        include_dirs=["/root/reference/src"],
+    )
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="60">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="ref_test_sockbuf" path="{plug}"/>
+      <host id="h0">
+        <process plugin="ref_test_sockbuf" starttime="1" arguments=""/>
+      </host>
+    </shadow>"""))
+    tier = ProcessTier(cfg, seed=6)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2500:])
+    assert "sockbuf test passed" in out
+    tier.close()
+
+
 def test_socketpair_full_duplex(capfd):
     """socketpair(AF_UNIX): both ends read what the other wrote
     (channel.c:22-33 linked byte queues, the reference's Channel)."""
