@@ -607,6 +607,9 @@ int api_writable(void* vctx, int fd) {
 }
 
 bool fd_ready2(Proc* p, int fd, unsigned char want) {
+    if (!want) return false; /* no interest: never a wake reason (the
+                                interposer passes want=0 placeholders
+                                for non-virtual fds it handles itself) */
     auto it = p->fds.find(fd);
     if (it == p->fds.end()) return true; /* error -> surface immediately */
     bool ready = false;

@@ -117,6 +117,33 @@ def test_reference_test_sockbuf_unmodified(capfd):
     tier.close()
 
 
+def test_reference_test_sleep_unmodified(capfd):
+    """src/test/sleep/test_sleep.c: sleep/usleep/nanosleep advance the
+    virtual clock as observed through BOTH libc clock_gettime and a raw
+    syscall(SYS_clock_gettime) — the raw-syscall escape hatch must not
+    leak real time."""
+    tier = _run_one(
+        "/root/reference/src/test/sleep/test_sleep.c", "ref_test_sleep", 7
+    )
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "sleep test passed" in out
+    tier.close()
+
+
+def test_reference_test_poll_unmodified(capfd):
+    """src/test/poll/test_poll.c: poll over simulated pipes (empty,
+    filled, timeout) and over a real creat() file fd (always ready —
+    poll(2) regular-file semantics)."""
+    tier = _run_one(
+        "/root/reference/src/test/poll/test_poll.c", "ref_test_poll", 8
+    )
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "poll test passed" in out
+    tier.close()
+
+
 def test_socketpair_full_duplex(capfd):
     """socketpair(AF_UNIX): both ends read what the other wrote
     (channel.c:22-33 linked byte queues, the reference's Channel)."""
