@@ -66,6 +66,13 @@ typedef struct GError {
 } GError;
 
 #define g_assert_no_error(err) g_assert((err) == NULL)
+#define g_assert_cmpstr(a, op, b) g_assert(strcmp((a), (b)) op 0)
+
+typedef const void* gconstpointer;
+typedef void* gpointer;
+typedef unsigned int guint;
+#define GUINT_TO_POINTER(u) ((gpointer)(unsigned long)(u))
+#define GPOINTER_TO_UINT(p) ((guint)(unsigned long)(p))
 
 static inline void g_free(void* p) { free(p); }
 
@@ -127,6 +134,12 @@ static inline void g_test_add_data_func(const char* name,
         _g_tests[_g_n_tests].data = data;
         _g_n_tests++;
     }
+}
+
+static inline void g_test_add_func(const char* name, void (*fn)(void)) {
+    /* data-less registration rides the same table via a cast: the
+     * runner passes a data pointer the function ignores */
+    g_test_add_data_func(name, 0, (void (*)(const void*))fn);
 }
 
 static inline int g_test_run(void) {

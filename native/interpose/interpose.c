@@ -119,6 +119,7 @@ typedef struct Vfd {
     unsigned char is_epoll;
     unsigned char is_timer;
     unsigned char is_udp;
+    unsigned char timer_realtime; /* timerfd clockid for ABSTIME math */
     unsigned char connect_started;
     /* SO_SNDBUF/SO_RCVBUF mirror (tcp.c:407-598 buffer family): a
      * user set disables autotune for that direction, exactly the
@@ -816,7 +817,6 @@ int socketpair(int domain, int type, int protocol, int fds[2]) {
 /* ------------------------------------------------------------- timerfd */
 
 int timerfd_create(int clockid, int flags) {
-    (void)clockid;
     if (!A) {
         errno = ENOSYS;
         return -1;
@@ -833,13 +833,13 @@ int timerfd_create(int clockid, int flags) {
     }
     Vfd* v = vfd_get(vfd);
     v->is_timer = 1;
+    v->timer_realtime = clockid == CLOCK_REALTIME;
     v->nonblock = (flags & TFD_NONBLOCK) ? 1 : 0;
     return vfd;
 }
 
 int timerfd_settime(int fd, int flags, const struct itimerspec* new_value,
                     struct itimerspec* old_value) {
-    (void)flags;
     (void)old_value;
     Vfd* v = vfd_get(fd);
     if (!v || !new_value) {
@@ -851,6 +851,16 @@ int timerfd_settime(int fd, int flags, const struct itimerspec* new_value,
     int64_t interval =
         (int64_t)new_value->it_interval.tv_sec * 1000000000LL +
         new_value->it_interval.tv_nsec;
+    if ((flags & TFD_TIMER_ABSTIME) && first != 0) {
+        /* absolute deadlines convert against the clock the fd was
+         * created on: CLOCK_MONOTONIC = virtual ns since boot,
+         * CLOCK_REALTIME = virtual ns offset to the Y2K emulated
+         * epoch (timer.c:23-42 absolute expirations); an already-past
+         * deadline fires immediately */
+        int64_t now = A->time_ns(A->ctx);
+        if (v->timer_realtime) now += EMULATED_EPOCH_NS;
+        first = first > now ? first - now : 1;
+    }
     if (A->timer_settime(A->ctx, v->rfd, first, interval) < 0) {
         errno = EBADF;
         return -1;
@@ -1654,6 +1664,70 @@ pid_t getpid(void) {
 }
 
 pid_t getppid(void) { return 1; }
+
+#include <sys/utsname.h>
+
+REAL(int, uname, (struct utsname*))
+
+int gethostname(char* buf, size_t len) {
+    if (!A) {
+        errno = ENOSYS;
+        return -1;
+    }
+    const char* name = A->host_name(A->ctx);
+    /* POSIX: ENAMETOOLONG when the (NUL-terminated) name doesn't fit —
+     * the reference's unistd test asserts exactly this for len=1 */
+    if (strlen(name) + 1 > len) {
+        errno = ENAMETOOLONG;
+        return -1;
+    }
+    strcpy(buf, name);
+    return 0;
+}
+
+int uname(struct utsname* u) {
+    if (!u) {
+        errno = EFAULT;
+        return -1;
+    }
+    int rv = get_real_uname()(u);
+    if (rv == 0 && A) {
+        /* nodename is the VIRTUAL host's (the reference reports
+         * emulated names, never the simulator machine's) */
+        snprintf(u->nodename, sizeof u->nodename, "%s",
+                 A->host_name(A->ctx));
+    }
+    return rv;
+}
+
+int kill(pid_t pid, int sig) {
+    /* self-signal routes to the virtual process's installed handler
+     * (the unistd test's getpid/kill validation); signalling another
+     * virtual process is not modeled */
+    if (sig < 0 || sig >= SIG_TABLE_MAX) {
+        errno = EINVAL;
+        return -1;
+    }
+    if (A && pid == getpid()) {
+        if (sig == 0) return 0;
+        SigProc* s = sig_pp();
+        if (s && s->h[sig]) {
+            s->h[sig](sig);
+            return 0;
+        }
+        if (s && s->ignored[sig]) return 0;
+        /* default disposition: ignore-class signals do nothing; every
+         * other default terminates THIS virtual process — never the
+         * simulator (exit() already models that via proc_exit) */
+        if (sig == SIGCHLD || sig == SIGURG || sig == SIGWINCH ||
+            sig == SIGCONT)
+            return 0;
+        A->proc_exit(A->ctx, 128 + sig); /* never returns */
+        return 0;
+    }
+    errno = EPERM;
+    return -1;
+}
 
 void exit(int code) {
     if (A) {

@@ -179,6 +179,10 @@ typedef struct ShimAPI {
     /* ---- v6: outbound bytes not yet delivered by the simulated
      * network (ioctl SIOCOUTQ; SIOCINQ is readable_n). ---- */
     int64_t (*fd_outq)(void* ctx, int fd);
+
+    /* ---- v7: the calling process's virtual hostname
+     * (gethostname/uname nodename). ---- */
+    const char* (*host_name)(void* ctx);
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

@@ -211,6 +211,7 @@ struct GThread {
 struct Proc {
     int32_t pid = -1;
     int32_t host = -1;
+    std::string host_name; /* virtual hostname (gethostname/uname) */
     bool started = false;
     bool done = false;
     int exit_code = 0;
@@ -804,7 +805,18 @@ int api_current_pid(void* vctx) {
 
 const char* api_env_get(void* vctx, const char* name) {
     (void)vctx;
-    return name ? getenv(name) : nullptr; /* base-namespace environ */
+    if (!name) return nullptr;
+    /* the reference re-execs itself with SHADOW_SPAWNED set so plugins
+     * can detect they run simulated (main.c:645-675); same contract */
+    if (strcmp(name, "SHADOW_SPAWNED") == 0) return "1";
+    return getenv(name); /* base-namespace environ */
+}
+
+/* virtual hostname of the calling process's host (gethostname/uname
+ * nodename; dns.c name registry pushed by the driver) */
+const char* api_host_name(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    return rt->current ? rt->current->host_name.c_str() : "";
 }
 
 /* -------------------------------------------------- v4: pthread shim */
@@ -975,6 +987,7 @@ ShimAPI make_api(Runtime* rt) {
     a.cond_signal = api_cond_signal;
     a.fd_activity = api_fd_activity;
     a.fd_outq = api_fd_outq;
+    a.host_name = api_host_name;
     return a;
 }
 
@@ -1227,6 +1240,15 @@ int shim_spawn(void* vrt, int host_gid, const char* so_path,
 
     rt->procs.push_back(p);
     return p->pid;
+}
+
+/* Record the virtual hostname a process runs on (driver-pushed). */
+int shim_set_host_name(void* vrt, int pid, const char* name) {
+    Runtime* rt = static_cast<Runtime*>(vrt);
+    if (pid < 0 || pid >= static_cast<int>(rt->procs.size()) || !name)
+        return -1;
+    rt->procs[pid]->host_name = name;
+    return 0;
 }
 
 /* Start a spawned process (its shim_main begins at the next pump). */

@@ -183,6 +183,9 @@ class ShimRuntime:
         lib.shim_kill.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
         ]
+        lib.shim_set_host_name.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ]
         self._lib = lib
         self._rt = lib.shim_init()
         self._req_buf = (ShimReq * max_reqs)()
@@ -206,6 +209,10 @@ class ShimRuntime:
 
     def start(self, pid: int) -> None:
         self._lib.shim_start(self._rt, pid)
+
+    def set_host_name(self, pid: int, name: str) -> None:
+        """Virtual hostname for gethostname/uname (dns.c name)."""
+        self._lib.shim_set_host_name(self._rt, pid, name.encode())
 
     def pump(self, now_ns: int, comps: list[tuple]) -> list[ShimReq]:
         """comps: [(pid, op, fd, r0[, pad])] -> emitted requests."""

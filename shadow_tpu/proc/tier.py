@@ -167,6 +167,7 @@ class ProcessTier:
                     )
                 argv = [os.path.basename(path)] + shlex.split(p.arguments)
                 pid = self.rt.spawn(gid, path, argv)
+                self.rt.set_host_name(pid, h.name)
                 self.pid_host[pid] = gid
                 heapq.heappush(self._starts, (int(p.starttime * SECOND), pid))
                 if p.stoptime:

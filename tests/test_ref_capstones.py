@@ -144,6 +144,69 @@ def test_reference_test_poll_unmodified(capfd):
     tier.close()
 
 
+def test_reference_test_unistd_unmodified(capfd):
+    """src/test/unistd/test_unistd.c: virtual getpid (stable, positive)
+    and gethostname returning the VIRTUAL host's name (with the
+    short-buffer ENAMETOOLONG case). The test detects it runs simulated
+    via getenv(SHADOW_SPAWNED) — served by the runtime, the reference's
+    re-exec contract (main.c:645-675)."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    src = "/root/reference/src/test/unistd/test_unistd.c"
+    if not os.path.exists(src):
+        pytest.skip("reference tree not mounted")
+    plug = compile_posix_plugin(
+        src, name="ref_test_unistd",
+        include_dirs=["/root/reference/src"],
+    )
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="30">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="ref_test_unistd" path="{plug}"/>
+      <host id="vhostname">
+        <process plugin="ref_test_unistd" starttime="1"
+          arguments="Linux vhostname rel ver x86_64"/>
+      </host>
+    </shadow>"""))
+    tier = ProcessTier(cfg, seed=9)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "ok: /unistd/gethostname" in out
+    tier.close()
+
+
+def test_reference_test_timerfd_unmodified(capfd):
+    """src/test/timerfd/test_timerfd.c: periodic expirations on the
+    virtual-time grid (relative and TFD_TIMER_ABSTIME), past-deadline
+    timers firing immediately, epoll over timerfds, and disarm. The
+    test assumes CLOCK_MONOTONIC's 5-second mark has already passed, so
+    the process starts at virtual t=6 (the reference's native runs rely
+    on machine uptime for the same assumption)."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    src = "/root/reference/src/test/timerfd/test_timerfd.c"
+    if not os.path.exists(src):
+        pytest.skip("reference tree not mounted")
+    plug = compile_posix_plugin(src, name="ref_test_timerfd")
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="40">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="ref_test_timerfd" path="{plug}"/>
+      <host id="h0">
+        <process plugin="ref_test_timerfd" starttime="6" arguments=""/>
+      </host>
+    </shadow>"""))
+    tier = ProcessTier(cfg, seed=10)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "timerfd_epoll test passed" in out
+    tier.close()
+
+
 def test_socketpair_full_duplex(capfd):
     """socketpair(AF_UNIX): both ends read what the other wrote
     (channel.c:22-33 linked byte queues, the reference's Channel)."""
