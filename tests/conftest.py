@@ -44,6 +44,7 @@ def pytest_collection_modifyitems(config, items):
         "test_tor_bitcoin.py", "test_multimodel.py", "test_tcp_matrix.py",
         "test_proc_tier.py", "test_multichip.py", "test_interpose.py",
         "test_proc_scale.py", "test_udp_tier.py", "test_pthreads_tier.py",
+        "test_ref_capstones.py",
     }
     for item in items:
         if item.fspath.basename in slow_files:
