@@ -77,9 +77,17 @@ static void vfd_reset_all(void);
  * copies, SUCCESSIVE runtimes (e.g. one simulation after another in the
  * same OS process) reuse one interposer copy — its per-process fd
  * tables then hold the PREVIOUS runtime's state under colliding pids,
- * so a runtime change clears them. */
+ * so a runtime change clears them. The change is detected by the
+ * api's GENERATION token (unique per Runtime instance), cached here by
+ * value: the previous Runtime (and the ShimAPI embedded in it) may
+ * already be freed, so dereferencing the stale `A` pointer would be a
+ * use-after-free — and comparing ctx pointers would miss a successor
+ * Runtime allocated at the freed one's reused heap address. */
 void shadow_interpose_install(const ShimAPI* api) {
-    if (A && api && A->ctx != api->ctx) vfd_reset_all();
+    static uint64_t last_generation = 0;
+    if (last_generation && api && last_generation != api->generation)
+        vfd_reset_all();
+    if (api) last_generation = api->generation;
     A = api;
 }
 
@@ -1284,6 +1292,11 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
     unsigned char* want = n <= 64 ? stack_w : malloc(n);
     unsigned char* ready = n <= 64 ? stack_o : malloc(n);
     if (!rfds || !want || !ready) {
+        if (n > 64) { /* free whichever of the three did allocate */
+            free(rfds);
+            free(want);
+            free(ready);
+        }
         errno = ENOMEM;
         return -1;
     }
@@ -1324,7 +1337,11 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
         A->poll_many(A->ctx, rfds, want, n, 0, ready);
         for (int i = 0; i < n; i++) {
             EpollWatch* w = &e->watch[i];
+            /* ONESHOT outranks ET: a fired ONESHOT watch stays disarmed
+             * until EPOLL_CTL_MOD regardless of new edges (Linux and
+             * the reference's EWF_ONESHOT_REPORTED, epoll.c) */
             if (w->reported && (w->events & EPOLLET) &&
+                !(w->events & EPOLLONESHOT) &&
                 (!ready[i] ||
                  A->fd_activity(A->ctx, rfds[i]) != w->rep_activity))
                 w->reported = 0; /* fresh edge */
@@ -1363,7 +1380,9 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
          * rescan once */
         A->poll_many(A->ctx, rfds, want, n, ms_to_ns(timeout_ms), ready);
     }
-    if (n > 64) {
+    if (n_alloc > 64) { /* n may have shrunk below 64 in the pass loop;
+                         * the buffers were sized (and heap-allocated)
+                         * for n_alloc watches */
         free(rfds);
         free(want);
         free(ready);
@@ -1494,7 +1513,11 @@ int sigaction(int signum, const struct sigaction* act,
     }
     if (oldact) {
         memset(oldact, 0, sizeof *oldact);
-        oldact->sa_handler = s->h[signum];
+        /* an ignored signal's stored handler is NULL — report SIG_IGN,
+         * not SIG_DFL, so the `if (signal(sig, h) == SIG_IGN) restore`
+         * idiom works */
+        oldact->sa_handler =
+            s->ignored[signum] ? SIG_IGN : s->h[signum];
     }
     if (!act) return 0;
     s->h[signum] = act->sa_handler;

@@ -183,6 +183,13 @@ typedef struct ShimAPI {
     /* ---- v7: the calling process's virtual hostname
      * (gethostname/uname nodename). ---- */
     const char* (*host_name)(void* ctx);
+
+    /* ---- v8: runtime generation token, unique per Runtime instance
+     * within one OS process. A shared interposer copy (dlopen fallback
+     * past the namespace budget) detects a runtime change by comparing
+     * this value — NOT the ctx pointer, whose heap address a successive
+     * `new Runtime()` commonly reuses after `delete`. ---- */
+    uint64_t generation;
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

@@ -242,6 +242,7 @@ struct Runtime {
     int32_t next_eph_port = 40000; /* ephemeral listen ports (bind :0) */
     int next_fd = kFirstFd;        /* global shim-fd counter */
     ShimAPI api{}; /* stable vtable handed to per-namespace interposers */
+    uint64_t generation = 0; /* assigned on first make_api (v8 token) */
 };
 
 thread_local Runtime* g_rt = nullptr;
@@ -988,6 +989,15 @@ ShimAPI make_api(Runtime* rt) {
     a.fd_activity = api_fd_activity;
     a.fd_outq = api_fd_outq;
     a.host_name = api_host_name;
+    /* generation token, one per Runtime instance (v8): a shared
+     * interposer detects runtime succession by value change, immune to
+     * the heap reusing a freed Runtime's address. Assign each Runtime
+     * its number on first make_api call and keep it stable afterwards
+     * (re-making the api mid-run must NOT look like a new runtime —
+     * that would wrongly clear sibling processes' fd tables). */
+    static uint64_t next_generation = 1;
+    if (rt->generation == 0) rt->generation = next_generation++;
+    a.generation = rt->generation;
     return a;
 }
 

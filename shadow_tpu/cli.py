@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from shadow_tpu import __version__
 from shadow_tpu.config import parse_config
-from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.core.timebase import MILLISECOND, SECOND
 from shadow_tpu.examples import example_config
 from shadow_tpu.sim import build_simulation
 
@@ -87,6 +87,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="arrange the mesh as M slices joined over DCN "
                         "(multi-slice; the reference's unfinished "
                         "multi-machine design, master.c:414-416)")
+    p.add_argument("--runahead", type=float, default=None,
+                   help="override the conservative window width in "
+                        "MILLISECONDS (options.c --runahead minTimeJump; "
+                        "default: the topology's minimum path latency). "
+                        "Wider windows mean fewer barriers but coarser "
+                        "cross-host packet timing: arrivals inside a "
+                        "window are deferred to its end")
     p.add_argument("--workers", "-w", type=int, default=None,
                    help="ignored (pthread-era flag; kept for compatibility)")
     p.add_argument("--scheduler-policy", "-p", default=None,
@@ -234,6 +241,10 @@ def main(argv=None) -> int:
         mesh=mesh, tcp_cc=args.tcp_congestion_control,
         rx_queue=args.router_queue, qdisc=args.interface_qdisc,
         interface_buffer=args.interface_buffer, locality=args.locality,
+        runahead_ns=(
+            int(args.runahead * MILLISECOND)
+            if args.runahead is not None else None
+        ),
     )
     if args.allow_queue_overflow:
         sim.strict_overflow = False

@@ -295,6 +295,23 @@ class FusedModel:
     def handler_rows(self) -> int:
         return max(m.handler_rows() for _, m, _ in self.parts)
 
+    def cpu_kind_cycles(self, n_kinds: int):
+        """Sum the parts' per-(host, kind) cycle tables: a fused model
+        must not silently drop a part's declared CPU charges (e.g. Tor
+        relay crypto) — the accepted-but-ignored failure mode this
+        codebase elsewhere hard-errors on. Each part's table is already
+        host-masked (rows it doesn't own are zero), so summation is the
+        exact composition."""
+        total = None
+        for _, m, _ in self.parts:
+            if not hasattr(m, "cpu_kind_cycles"):
+                continue
+            cy = m.cpu_kind_cycles(n_kinds)
+            if cy is None:
+                continue
+            total = cy if total is None else total + cy
+        return total
+
     def build(self, b: SimBuild):
         n = b.n_hosts
         model_id = np.zeros((n,), np.int32)
@@ -390,6 +407,7 @@ def build_simulation(
     interface_buffer: int = 1_024_000,
     tcp_child_slot_limit: int | None = None,
     locality: bool = False,
+    runahead_ns: int | None = None,
 ) -> Simulation:
     """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
 
@@ -649,7 +667,19 @@ def build_simulation(
         need = model.app_rows() + 1
     max_emit = max(need, model.handler_rows())
 
-    lookahead = max(int(topo.min_latency_ms * MILLISECOND), 1)
+    # conservative window width: the topology's minimum path latency by
+    # default, overridable by the user (the reference exposes the same
+    # knob as --runahead / minTimeJump, options.c; master.c:133-159).
+    # Wider than min latency is SAFE for causality — cross-host arrivals
+    # are clamped up to the window barrier (engine._route), exactly the
+    # reference's barrier clamp — it just coarsens packet timing by up
+    # to the window width, the documented runahead tradeoff.
+    if runahead_ns is not None:
+        if runahead_ns < 1:
+            raise ValueError(f"runahead must be >= 1 ns, got {runahead_ns}")
+        lookahead = runahead_ns
+    else:
+        lookahead = max(int(topo.min_latency_ms * MILLISECOND), 1)
     if mesh is not None:
         from shadow_tpu.parallel.mesh import hosts_axes
 
