@@ -162,11 +162,14 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
 
 
 # tor tiers, SMALLEST first: the 76-host shape lands a guaranteed number
-# before the climb to 304 and 1020 hosts (BASELINE config 3). The r03
-# failure mode was every tier timing out mid-compile — so each tier's
-# first successful compile is banked in .jax_cache, and a later run (or
-# round) on the same machine reloads it in seconds instead of minutes.
-TOR_TIERS = ((4, 60, 4), (30, 204, 10), (110, 660, 30))
+# before the climb to 304, 1020, and 10000 hosts (BASELINE configs 3-4).
+# The r03 failure mode was every tier timing out mid-compile — so each
+# tier's first successful compile is banked in .jax_cache, and a later
+# run (or round) on the same machine reloads it in seconds instead of
+# minutes. Tier 3 is the north-star shape itself: 10k hosts (3000 relays
+# + 6700 torperf clients + 300 servers, BASELINE config 4 / the
+# 2018-ccs-tmodel framing).
+TOR_TIERS = ((4, 60, 4), (30, 204, 10), (110, 660, 30), (1000, 6700, 300))
 
 
 def _stamp(msg: str) -> None:
@@ -177,48 +180,56 @@ def _stamp(msg: str) -> None:
 
 
 def tor_worker():
-    """Secondary metric: Tor-circuit workload (BASELINE config 3: '1k-node
-    Tor network ... relays + clients') at the BENCH_TOR_TIER size.
-    BENCH_TOR_CPU=1 switches on the relay-crypto CPU model (cycles per
-    forwarded segment, models/tor.py RELAY_CYCLES_PER_BYTE), reported
-    under tor_cpu_* keys so both variants can sit side by side."""
+    """Secondary metric: Tor-circuit workload (BASELINE configs 3-4) at
+    the BENCH_TOR_TIER size. The relay-crypto CPU model (cycles per
+    forwarded segment, models/tor.py RELAY_CYCLES_PER_BYTE) is ON by
+    default — reference hosts always pay CPU (cpu.c:56-107) — so tor_*
+    is the honest headline; BENCH_TOR_CPU=0 reports the model-off
+    variant under tor_nocpu_* for the side-by-side. Tier 3 reports under
+    tor10k_* (the north-star shape must have its own stable keys)."""
     _enable_compile_cache()
     import jax
 
     from shadow_tpu.config import parse_config
-    from shadow_tpu.core.timebase import SECOND
+    from shadow_tpu.core.timebase import MILLISECOND, SECOND
     from shadow_tpu.examples import tor_example
     from shadow_tpu.sim import build_simulation
 
-    with_cpu = os.environ.get("BENCH_TOR_CPU") == "1"
+    with_cpu = os.environ.get("BENCH_TOR_CPU", "1") != "0"
     # one tier per process (a faulted in-process backend cannot be
     # reinitialized, so tier walking happens across fresh subprocesses)
     tier_idx = int(os.environ.get("BENCH_TOR_TIER", 0)) % len(TOR_TIERS)
     relays, clients, servers = TOR_TIERS[tier_idx]
     # measured horizon shrinks with tier size so every tier's timed run
-    # fits a per-round budget (~1 wall-minute per sim-second at 1020
-    # hosts on one chip); sim-s/wall-s is horizon-independent
-    stop_s = (20, 10, 5)[tier_idx]
+    # fits a per-round budget; sim-s/wall-s is horizon-independent
+    stop_s = (20, 10, 5, 3)[tier_idx]
     _stamp(f"tor tier {relays}/{clients}/{servers} cpu={with_cpu}: building")
     cfg = parse_config(tor_example(
         n_relays_per_class=relays, n_clients=clients,
         n_servers=servers, filesize="64KiB", count=2, stoptime=stop_s,
         relay_cpu_ghz=3.0 if with_cpu else 0.0,
     ))
-    sim = build_simulation(cfg, seed=1, n_sockets=48, capacity=768)
+    runahead_ms = float(os.environ.get("BENCH_RUNAHEAD_MS", 0))
+    sim = build_simulation(
+        cfg, seed=1, n_sockets=48, capacity=768,
+        runahead_ns=(
+            int(runahead_ms * MILLISECOND) if runahead_ms > 0 else None
+        ),
+    )
+    drain_b = int(os.environ.get("BENCH_DRAIN_B", 0))
+    if drain_b:
+        import dataclasses as _dc
+        sim.engine.cfg = _dc.replace(sim.engine.cfg, drain_batch=drain_b)
     sim.strict_overflow = False
     _stamp("build done; compiling + first chunk")
     # CHUNKED execution: one long device invocation trips the axon
     # tunnel's deadline and kills the whole program (UNAVAILABLE: TPU
-    # device error — root-caused this round: the identical sim completes
+    # device error — root-caused in r04: the identical sim completes
     # when each run() call covers ~1 sim-s, and faults when it covers
     # all 20). Chunking costs a host round trip per sim-second and saves
     # the workload. docs/5-Known-Issues.md has the fault matrix.
-    tier_i = int(os.environ.get("BENCH_TOR_TIER", 0)) % len(TOR_TIERS)
-    # the 1020-host tier runs ~1 wall-minute per sim-second on one chip:
-    # even a 1-sim-s chunk trips the tunnel deadline, so it steps finer
     chunk_s = float(os.environ.get("BENCH_CHUNK_S",
-                                   0.25 if tier_i == 2 else 1.0))
+                                   0.25 if tier_idx >= 2 else 1.0))
     chunk_ns = max(int(chunk_s * SECOND), 1)
     st = sim.run(chunk_ns)
     jax.block_until_ready(st.now)
@@ -234,14 +245,29 @@ def tor_worker():
     # late fault cannot discard an already-measured result upstream
     n_streams = int(jax.device_get(st.hosts.app.streams_done.sum()))
     relayed = int(jax.device_get(st.hosts.app.relayed_bytes.sum()))
+    # scheduler self-profiling (scheduler.c:266-271 analog): the r04
+    # verdict's ask — sweeps/windows/inner-steps make the per-sweep
+    # fixed cost attributable instead of guessed at
+    n_events = int(jax.device_get(st.stats.n_executed.sum()))
+    sweeps = int(jax.device_get(st.stats.n_sweeps))
+    inner = int(jax.device_get(st.stats.n_inner_steps))
+    windows = int(jax.device_get(st.stats.n_windows))
     wall = time.perf_counter() - t0
     _stamp(f"timed run done in {wall:.2f}s")
-    pre = "tor_cpu_" if with_cpu else "tor_"
+    pre = ("tor_" if with_cpu else "tor_nocpu_")
+    if tier_idx == 3:
+        pre = "tor10k_"
     print(json.dumps({
         f"{pre}hosts": len(sim.names),
         f"{pre}sim_s_per_wall_s": round(stop_s / max(wall, 1e-9), 3),
         f"{pre}streams_done": n_streams,
         f"{pre}relayed_mib": relayed >> 20,
+        f"{pre}events": n_events,
+        f"{pre}windows": windows,
+        f"{pre}sweeps": sweeps,
+        f"{pre}inner_steps": inner,
+        f"{pre}events_per_sweep": round(n_events / max(sweeps, 1), 2),
+        f"{pre}cpu_model": with_cpu,
     }))
 
 
@@ -262,7 +288,9 @@ def btc_worker():
     ))
     sim = build_simulation(cfg, seed=1, n_sockets=16, capacity=768)
     sim.strict_overflow = False
-    chunk_s = int(os.environ.get("BENCH_CHUNK_S", 5))
+    # 1-sim-s chunks: the 5-s chunks of r04 tripped the axon tunnel's
+    # long-invocation deadline and crashed the TPU worker twice
+    chunk_s = int(os.environ.get("BENCH_CHUNK_S", 1))
     stop_s = int(cfg.stoptime)
     _stamp("btc build done; compiling + first chunk")
     st = sim.run(chunk_s * SECOND)
@@ -428,7 +456,7 @@ def main():
     # smallest-first across FRESH subprocesses; each success overwrites
     # the tor_* keys, so the final dict carries the LARGEST tier that
     # ran.
-    os.environ.pop("BENCH_TOR_CPU", None)
+    os.environ.pop("BENCH_TOR_CPU", None)  # default: CPU model ON (tor_*)
     tor_ok = False
     for tier in (0, 1):
         os.environ["BENCH_TOR_TIER"] = str(tier)
@@ -440,10 +468,11 @@ def main():
         out.update(rt)
         print(json.dumps(out), flush=True)
     if tor_ok:
-        # the relay-crypto CPU-model variant at the smallest tier (the
-        # with/without pair the r03 verdict asked for; VERDICT item 8)
+        # the CPU-model-off variant at the smallest tier: the with/without
+        # pair, now with the honest (CPU on) number as the headline
+        # (r03/r04 verdict item 8)
         os.environ["BENCH_TOR_TIER"] = "0"
-        os.environ["BENCH_TOR_CPU"] = "1"
+        os.environ["BENCH_TOR_CPU"] = "0"
         rc = run_secondary("--tor-worker", nominal_timeout=420)
         os.environ.pop("BENCH_TOR_CPU", None)
         if rc:
@@ -468,13 +497,15 @@ def main():
         })
         print(json.dumps(out), flush=True)
     if tor_ok:
-        # the 1020-host tier with whatever budget remains (completes in
-        # ~0.25-sim-s chunks; a timeout here costs nothing already won)
-        os.environ["BENCH_TOR_TIER"] = "2"
-        rt2 = run_secondary("--tor-worker", nominal_timeout=2400)
-        if rt2:
-            out.update(rt2)
-            print(json.dumps(out), flush=True)
+        # the 1020-host tier, then the 10k north-star shape, with
+        # whatever budget remains (a timeout here costs nothing already
+        # won; the 10k compile banks in .jax_cache either way)
+        for tier, tmo in (("2", 2400), ("3", 3000)):
+            os.environ["BENCH_TOR_TIER"] = tier
+            rt2 = run_secondary("--tor-worker", nominal_timeout=tmo)
+            if rt2:
+                out.update(rt2)
+                print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

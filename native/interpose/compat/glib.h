@@ -71,8 +71,18 @@ typedef struct GError {
 typedef const void* gconstpointer;
 typedef void* gpointer;
 typedef unsigned int guint;
+typedef int gint;
 #define GUINT_TO_POINTER(u) ((gpointer)(unsigned long)(u))
 #define GPOINTER_TO_UINT(p) ((guint)(unsigned long)(p))
+#define GINT_TO_POINTER(i) ((gpointer)(long)(i))
+#define GPOINTER_TO_INT(p) ((gint)(long)(p))
+#define g_assert_cmpuint(a, op, b) g_assert((a)op(b))
+
+/* g_auto scoped-cleanup support (the real GLib builds on the same
+ * compiler cleanup attribute) */
+#define G_DEFINE_AUTO_CLEANUP_CLEAR_FUNC(Type, func)                       \
+    static inline void _g_auto_cleanup_##Type(Type* p) { func(p); }
+#define g_auto(Type) __attribute__((cleanup(_g_auto_cleanup_##Type))) Type
 
 static inline void g_free(void* p) { free(p); }
 

@@ -1,0 +1,33 @@
+/* Minimal support/logger/logger.h stand-in for compiling reference test
+ * sources (e.g. /root/reference/src/test/bind/test_bind.c) that include
+ * the reference's logger header only for its debug/message/warning/error
+ * convenience macros. Output goes straight to stdio — inside the
+ * simulator the virtual process's stdout is already captured per pid.
+ * This is an original compatibility shim, not reference code. */
+#ifndef SHADOW_TPU_COMPAT_LOGGER_H
+#define SHADOW_TPU_COMPAT_LOGGER_H
+
+#include <stdio.h>
+#include <stdlib.h>
+
+#define _shadow_log(level, ...)                                            \
+    do {                                                                   \
+        fprintf(stdout, "[%s] ", level);                                   \
+        fprintf(stdout, __VA_ARGS__);                                      \
+        fprintf(stdout, "\n");                                             \
+        fflush(stdout);                                                    \
+    } while (0)
+
+/* the reference's error() aborts the process (logger.c LOGLEVEL_ERROR) */
+#define error(...)                                                         \
+    do {                                                                   \
+        _shadow_log("error", __VA_ARGS__);                                 \
+        exit(EXIT_FAILURE);                                                \
+    } while (0)
+#define critical(...) _shadow_log("critical", __VA_ARGS__)
+#define warning(...) _shadow_log("warning", __VA_ARGS__)
+#define message(...) _shadow_log("message", __VA_ARGS__)
+#define info(...) _shadow_log("info", __VA_ARGS__)
+#define debug(...) _shadow_log("debug", __VA_ARGS__)
+
+#endif /* SHADOW_TPU_COMPAT_LOGGER_H */

@@ -38,6 +38,8 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <arpa/inet.h>
+#include <fcntl.h>
+#include <signal.h>
 #include <poll.h>
 #include <stdarg.h>
 #include <stdint.h>
@@ -71,6 +73,9 @@
 static const ShimAPI* A = 0;
 
 static void vfd_reset_all(void);
+static void sig_raise_self(int sig);
+static void rng_fill(void* buf, size_t n);
+static void rng_reset_all(void);
 
 /* The runtime calls this right after loading a plugin whose lookup
  * scope contains this library. When the namespace budget forces shared
@@ -136,6 +141,15 @@ typedef struct Vfd {
      * the device stack's rwnd autotune, documented as such. */
     unsigned char no_autotune_snd;
     unsigned char no_autotune_rcv;
+    /* shutdown(2) half-close state (tcp.c shutdown semantics): WR sends
+     * the FIN (sock_close keeps the in-stream alive) and later sends
+     * fail EPIPE; RD makes an empty receive return EOF instead of
+     * blocking, while buffered AND newly-arriving data stay readable
+     * (the Linux-observed behavior the reference test documents). */
+    unsigned char rd_shut;
+    unsigned char wr_shut;
+    unsigned char is_urandom; /* /dev/urandom: reads from the per-host
+                                 deterministic stream (random.c:15-50) */
     unsigned int snd_size;
     unsigned int rcv_size;
     int rfd; /* runtime fd; -1 for interposer-local (epoll) */
@@ -249,6 +263,7 @@ static void vfd_reset_all(void) {
     g_pp = 0;
     g_npp = 0;
     sig_reset_all();
+    rng_reset_all();
 }
 
 /* ----------------------------------------------------------- sockets */
@@ -286,6 +301,14 @@ int socket(int domain, int type, int protocol) {
     return vfd;
 }
 
+/* sock_bind/udp_bind2 result contract (shim_api.h v9): >0 bound port,
+ * -1 EBADF, -2 EADDRINUSE, -3 EINVAL (already bound) */
+static int map_bind_result(int rv) {
+    if (rv > 0) return 0;
+    errno = rv == -2 ? EADDRINUSE : rv == -3 ? EINVAL : EBADF;
+    return -1;
+}
+
 int bind(int fd, const struct sockaddr* addr, socklen_t len) {
     Vfd* v = vfd_get(fd);
     if (!v) {
@@ -300,17 +323,9 @@ int bind(int fd, const struct sockaddr* addr, socklen_t len) {
     if (v->is_udp) {
         /* datagram bind goes straight into the device demux (udp.c
          * association; TCP defers to listen) */
-        if (A->udp_bind(A->ctx, v->rfd, port) < 0) {
-            errno = EADDRINUSE;
-            return -1;
-        }
-        return 0;
+        return map_bind_result(A->udp_bind2(A->ctx, v->rfd, port, 1));
     }
-    if (A->sock_bind(A->ctx, v->rfd, port) < 0) {
-        errno = EBADF;
-        return -1;
-    }
-    return 0;
+    return map_bind_result(A->sock_bind(A->ctx, v->rfd, port));
 }
 
 int listen(int fd, int backlog) {
@@ -442,6 +457,13 @@ ssize_t send(int fd, const void* buf, size_t n, int flags) {
         }
         return (ssize_t)rv;
     }
+    if (v->wr_shut) {
+        /* write side already shut down: EPIPE (the SIGPIPE the kernel
+         * would raise is honored through the virtual signal table) */
+        sig_raise_self(SIGPIPE);
+        errno = EPIPE;
+        return -1;
+    }
     int64_t rv = A->sock_send(A->ctx, v->rfd, buf, (int64_t)n);
     if (rv < 0) {
         errno = EPIPE;
@@ -489,6 +511,12 @@ ssize_t recv(int fd, void* buf, size_t cap, int flags) {
         }
         return (ssize_t)rv;
     }
+    if (v->rd_shut && A->readable_n(A->ctx, v->rfd) <= 0) {
+        /* SHUT_RD with nothing buffered reads EOF instead of blocking;
+         * data already queued (or arriving later) stays readable — the
+         * Linux behavior the reference's shutdown test documents */
+        return 0;
+    }
     if (v->nonblock) {
         if (A->readable_n(A->ctx, v->rfd) <= 0 &&
             !A->at_eof(A->ctx, v->rfd)) {
@@ -531,6 +559,10 @@ ssize_t recvfrom(int fd, void* buf, size_t cap, int flags,
 ssize_t read(int fd, void* buf, size_t cap) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_read()(fd, buf, cap);
+    if (v->is_urandom) {
+        rng_fill(buf, cap);
+        return (ssize_t)cap;
+    }
     if (v->is_timer) {
         /* timerfd read: u64 expiration count (timer.c:23-42) */
         if (cap < 8) {
@@ -562,12 +594,13 @@ ssize_t write(int fd, const void* buf, size_t n) {
     return send(fd, buf, n, 0);
 }
 
+REAL(ssize_t, readv, (int, const struct iovec*, int))
+REAL(ssize_t, writev, (int, const struct iovec*, int))
+
 ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
     Vfd* v = vfd_get(fd);
-    if (!v) {
-        errno = EBADF;
-        return -1;
-    }
+    if (!v) return get_real_readv()(fd, iov, iovcnt); /* real files:
+        kernel semantics incl. EINVAL/EBADF edges (test_file.c) */
     /* one recv's worth of bytes scattered across the iov — readv's
      * single-message semantics over a stream */
     size_t total = 0;
@@ -596,10 +629,7 @@ ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
 
 ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
     Vfd* v = vfd_get(fd);
-    if (!v) {
-        errno = EBADF;
-        return -1;
-    }
+    if (!v) return get_real_writev()(fd, iov, iovcnt);
     ssize_t total = 0;
     for (int i = 0; i < iovcnt; i++) {
         if (iov[i].iov_len == 0) continue;
@@ -632,10 +662,10 @@ int close(int fd) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_close()(fd);
     int rfd = v->rfd;
-    int local = v->is_epoll;
+    int local = v->is_epoll || v->is_urandom;
     epoll_forget(fd);
     vfd_free(fd);
-    if (local) return 0; /* epoll instances are interposer-local */
+    if (local) return 0; /* epoll/urandom fds are interposer-local */
     return A->sock_close(A->ctx, rfd);
 }
 
@@ -645,10 +675,25 @@ int shutdown(int fd, int how) {
         errno = EBADF;
         return -1;
     }
-    if (how == SHUT_WR || how == SHUT_RDWR) {
+    if (how != SHUT_RD && how != SHUT_WR && how != SHUT_RDWR) {
+        errno = EINVAL;
+        return -1;
+    }
+    /* only a connected stream can be shut down (tcp.c shutdown:
+     * ENOTCONN pre-handshake; UDP sockets here are never connect()ed) */
+    if (v->is_udp || v->is_epoll || v->is_timer ||
+        A->conn_status(A->ctx, v->rfd) != 1) {
+        errno = ENOTCONN;
+        return -1;
+    }
+    if (how == SHUT_RD || how == SHUT_RDWR) v->rd_shut = 1;
+    if ((how == SHUT_WR || how == SHUT_RDWR) && !v->wr_shut) {
+        v->wr_shut = 1;
         /* FIN the write side; reads continue until EOF (the runtime
-         * keeps the in-stream alive after close, tcp.c semantics) */
-        return A->sock_close(A->ctx, v->rfd);
+         * keeps the in-stream alive after close, tcp.c semantics).
+         * Queued bytes drain before the FIN — the device TCP holds
+         * fin_pending until the send buffer empties. */
+        A->sock_close(A->ctx, v->rfd);
     }
     return 0;
 }
@@ -1390,6 +1435,183 @@ int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
     return count;
 }
 
+/* --------------------------------------------- deterministic randomness */
+
+/* The reference routes every plugin randomness source — rand(),
+ * getrandom(), /dev/urandom reads — to the owning host's seeded stream
+ * (process.c:2676-2677,4321-4324; random.c:15-50), so simulations are
+ * bit-reproducible whatever the plugin does. Same contract here: a
+ * per-process xorshift64* stream seeded from the runtime's
+ * (sim seed, host, pid) chain (ShimAPI v10 rand_seed). */
+
+typedef struct RngProc {
+    uint64_t s;
+    unsigned char seeded;
+} RngProc;
+
+static RngProc* g_rng = 0;
+static int g_nrng = 0;
+
+static void rng_reset_all(void) {
+    free(g_rng);
+    g_rng = 0;
+    g_nrng = 0;
+}
+
+static RngProc* rng_pp(void) {
+    int pid = A ? A->current_pid(A->ctx) : -1;
+    if (pid < 0) return 0;
+    if (pid >= g_nrng) {
+        int n = g_nrng ? g_nrng : 16;
+        while (n <= pid) n *= 2;
+        RngProc* t = realloc(g_rng, n * sizeof(RngProc));
+        if (!t) return 0;
+        memset(t + g_nrng, 0, (n - g_nrng) * sizeof(RngProc));
+        g_rng = t;
+        g_nrng = n;
+    }
+    RngProc* r = &g_rng[pid];
+    if (!r->seeded) {
+        r->s = A->rand_seed(A->ctx);
+        if (!r->s) r->s = 0x9E3779B97F4A7C15ULL;
+        r->seeded = 1;
+    }
+    return r;
+}
+
+static uint64_t rng_next(void) {
+    RngProc* r = rng_pp();
+    if (!r) return 0x2545F4914F6CDD1DULL;
+    uint64_t x = r->s;
+    x ^= x >> 12;
+    x ^= x << 25;
+    x ^= x >> 27;
+    r->s = x;
+    return x * 0x2545F4914F6CDD1DULL;
+}
+
+static void rng_fill(void* buf, size_t n) {
+    unsigned char* p = buf;
+    while (n >= 8) {
+        uint64_t x = rng_next();
+        memcpy(p, &x, 8);
+        p += 8;
+        n -= 8;
+    }
+    if (n) {
+        uint64_t x = rng_next();
+        memcpy(p, &x, n);
+    }
+}
+
+int rand(void) { return (int)(rng_next() >> 33); /* [0, RAND_MAX] */ }
+
+long random(void) { return (long)(rng_next() >> 33); }
+
+void srand(unsigned int seed) {
+    RngProc* r = rng_pp();
+    if (!r) return;
+    /* reseed deterministically from (host chain, caller seed) */
+    r->s = A->rand_seed(A->ctx) ^ (0x6A09E667F3BCC909ULL * (seed + 1));
+    if (!r->s) r->s = 1;
+    r->seeded = 1;
+}
+
+void srandom(unsigned int seed) { srand(seed); }
+
+ssize_t getrandom(void* buf, size_t buflen, unsigned int flags) {
+    (void)flags;
+    if (!buf) {
+        errno = EFAULT;
+        return -1;
+    }
+    rng_fill(buf, buflen);
+    return (ssize_t)buflen;
+}
+
+/* open(2) family: only /dev/urandom and /dev/random are virtualized
+ * (they must come from the deterministic stream); every other path
+ * passes through to the real filesystem — plugin file IO is ordinary
+ * host IO here, exactly like the reference's unmanaged file paths. */
+
+REAL(int, open, (const char*, int, ...))
+REAL(int, openat, (int, const char*, int, ...))
+
+#ifndef O_LARGEFILE
+#define O_LARGEFILE 0
+#endif
+
+static int is_urandom_path(const char* path) {
+    return path && (strcmp(path, "/dev/urandom") == 0 ||
+                    strcmp(path, "/dev/random") == 0);
+}
+
+static int open_urandom_vfd(void) {
+    int vfd = vfd_alloc(-1);
+    if (vfd < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    vfd_get(vfd)->is_urandom = 1;
+    return vfd;
+}
+
+int open(const char* path, int flags, ...) {
+    if (A && is_urandom_path(path)) return open_urandom_vfd();
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return get_real_open()(path, flags, mode);
+}
+
+int open64(const char* path, int flags, ...) {
+    if (A && is_urandom_path(path)) return open_urandom_vfd();
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return get_real_open()(path, flags | O_LARGEFILE, mode);
+}
+
+int openat(int dirfd, const char* path, int flags, ...) {
+    if (A && is_urandom_path(path)) return open_urandom_vfd();
+    va_list ap;
+    va_start(ap, flags);
+    mode_t mode = va_arg(ap, mode_t);
+    va_end(ap);
+    return get_real_openat()(dirfd, path, flags, mode);
+}
+
+/* fopen reaches the kernel through glibc's INTERNAL __open alias — not
+ * the PLT — so the open() interposition above cannot catch
+ * fopen("/dev/urandom"). A cookie stream whose read callback is the
+ * deterministic generator covers the stdio route too. */
+REAL(FILE*, fopen, (const char*, const char*))
+REAL(FILE*, fopen64, (const char*, const char*))
+
+static ssize_t urand_cookie_read(void* cookie, char* buf, size_t n) {
+    (void)cookie;
+    rng_fill(buf, n);
+    return (ssize_t)n;
+}
+
+FILE* fopen(const char* path, const char* mode) {
+    if (A && is_urandom_path(path)) {
+        cookie_io_functions_t io = {urand_cookie_read, 0, 0, 0};
+        return fopencookie(0, "r", io);
+    }
+    return get_real_fopen()(path, mode);
+}
+
+FILE* fopen64(const char* path, const char* mode) {
+    if (A && is_urandom_path(path)) {
+        cookie_io_functions_t io = {urand_cookie_read, 0, 0, 0};
+        return fopencookie(0, "r", io);
+    }
+    return get_real_fopen64()(path, mode);
+}
+
 /* ------------------------------------------------------ SysV msg queues */
 
 /* msgget/msgctl pass through (a real kernel queue inside the simulator
@@ -1481,6 +1703,21 @@ static SigProc* sig_pp(void) {
         g_nsig = n;
     }
     return &g_sig[pid];
+}
+
+/* deliver a synchronously-raised signal (e.g. EPIPE's SIGPIPE) to the
+ * CURRENT virtual process: installed handler, SIG_IGN swallow, or the
+ * default disposition (termination of the virtual process) */
+static void sig_raise_self(int sig) {
+    if (sig < 0 || sig >= SIG_TABLE_MAX) return;
+    SigProc* s = sig_pp();
+    if (!s) return;
+    if (s->h[sig]) {
+        s->h[sig](sig);
+        return;
+    }
+    if (s->ignored[sig]) return;
+    if (A) A->proc_exit(A->ctx, 128 + sig); /* never returns */
 }
 
 static void sig_trampoline(int sn) {

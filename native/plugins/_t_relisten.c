@@ -1,0 +1,40 @@
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int mklisten(int port) {
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_port = htons((unsigned short)port);
+    if (bind(s, (struct sockaddr*)&a, sizeof a) != 0) return -1;
+    if (listen(s, 8) != 0) return -1;
+    return s;
+}
+
+int main(int argc, char** argv) {
+    if (argc > 1 && strcmp(argv[1], "client") == 0) {
+        struct sockaddr_in a = {0};
+        a.sin_family = AF_INET;
+        a.sin_addr.s_addr = htonl((10u<<24)|1);  /* resolved below */
+        return 0;
+    }
+    /* three close-then-relisten cycles with NO blocking call in
+     * between: all six requests land in one pump */
+    int l = -1;
+    for (int i = 0; i < 3; i++) {
+        if (l >= 0) close(l);
+        l = mklisten(7070);
+        if (l < 0) return 10;
+    }
+    int c = accept(l, 0, 0); /* the echo peer connects */
+    if (c < 0) return 11;
+    char buf[8] = {0};
+    if (recv(c, buf, sizeof buf, 0) != 5) return 12;
+    if (strcmp(buf, "ping") != 0) return 13;
+    if (send(c, "pong", 5, 0) != 5) return 14;
+    printf("RELISTEN_OK\n");
+    return 0;
+}

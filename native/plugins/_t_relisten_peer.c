@@ -1,0 +1,21 @@
+#include <netdb.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(void) {
+    struct addrinfo h = {0}, *ai = 0;
+    h.ai_family = AF_INET;
+    h.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo("srv", "7070", &h, &ai) != 0) return 20;
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(s, ai->ai_addr, ai->ai_addrlen) != 0) return 21;
+    if (send(s, "ping", 5, 0) != 5) return 22;
+    char buf[8] = {0};
+    if (recv(s, buf, sizeof buf, 0) != 5) return 23;
+    if (strcmp(buf, "pong") != 0) return 24;
+    printf("RELISTEN_PEER_OK\n");
+    return 0;
+}

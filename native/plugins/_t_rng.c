@@ -1,0 +1,12 @@
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+int main(void) {
+    unsigned v = 0;
+    int fd = open("/dev/urandom", O_RDONLY);
+    if (fd < 0 || read(fd, &v, sizeof v) != sizeof v) return 1;
+    close(fd);
+    printf("URND %u RAND %d %d\n", v, rand(), rand());
+    return 0;
+}

@@ -1,0 +1,12 @@
+#include "shim_api.h"
+#include <stdio.h>
+int shim_main(const ShimAPI* a, int argc, char** argv) {
+    void* c = a->ctx;
+    long long t0 = a->time_ns(c);
+    a->sleep_ns(c, 3000000000LL); /* 3 virtual seconds */
+    long long t1 = a->time_ns(c);
+    char m[64];
+    snprintf(m, sizeof m, "slept %lld", t1 - t0);
+    a->log_msg(c, m);
+    return (t1 - t0 >= 3000000000LL) ? 0 : 1;
+}

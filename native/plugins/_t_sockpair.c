@@ -1,0 +1,21 @@
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(void) {
+    int sv[2];
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) return 10;
+    char buf[16] = {0};
+    if (write(sv[0], "ping", 5) != 5) return 11;
+    if (read(sv[1], buf, sizeof buf) != 5) return 12;
+    if (strcmp(buf, "ping") != 0) return 13;
+    if (write(sv[1], "pong", 5) != 5) return 14;  /* reverse */
+    memset(buf, 0, sizeof buf);
+    if (read(sv[0], buf, sizeof buf) != 5) return 15;
+    if (strcmp(buf, "pong") != 0) return 16;
+    close(sv[0]);
+    if (read(sv[1], buf, sizeof buf) != 0) return 17; /* EOF */
+    printf("SOCKETPAIR_OK\n");
+    return 0;
+}

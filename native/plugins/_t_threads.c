@@ -1,0 +1,41 @@
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+static int pipefd[2];
+static int counted = 0;
+static pthread_mutex_t mux = PTHREAD_MUTEX_INITIALIZER;
+
+static void* reader(void* arg) {
+    char buf[32] = {0};
+    ssize_t n = read(pipefd[0], buf, sizeof buf); /* blocks */
+    if (n <= 0 || strcmp(buf, "payload") != 0) return (void*)1;
+    return (void*)0;
+}
+
+static void* counter(void* arg) {
+    for (int i = 0; i < 1000; i++) {
+        pthread_mutex_lock(&mux);
+        counted++;
+        pthread_mutex_unlock(&mux);
+    }
+    return (void*)0;
+}
+
+int main(void) {
+    if (pipe(pipefd) != 0) return 10;
+    pthread_t tr, tc;
+    pthread_create(&tr, NULL, reader, NULL);
+    pthread_create(&tc, NULL, counter, NULL);
+    /* while the reader blocks, virtual time passes and the
+     * counter finishes */
+    usleep(500000);
+    if (write(pipefd[1], "payload", 8) != 8) return 11;
+    void *r1, *r2;
+    pthread_join(tr, &r1);
+    pthread_join(tc, &r2);
+    if (r1 || r2 || counted != 1000) return 12;
+    printf("THREADS_OK %d\n", counted);
+    return 0;
+}

@@ -190,6 +190,19 @@ typedef struct ShimAPI {
      * this value — NOT the ctx pointer, whose heap address a successive
      * `new Runtime()` commonly reuses after `delete`. ---- */
     uint64_t generation;
+
+    /* ---- v9: bind error-path parity (src/test/bind/test_bind.c).
+     * sock_bind and udp_bind2 return >0 bound port, -1 bad fd (EBADF),
+     * -2 port taken on this host (EADDRINUSE), -3 already bound
+     * (EINVAL). udp_bind2's explicit flag distinguishes a user bind(2)
+     * from the send path's idempotent auto-bind. ---- */
+    int (*udp_bind2)(void* ctx, int fd, int port, int explicit_bind);
+
+    /* ---- v10: per-process deterministic random seed (the reference
+     * seeds each host's random.c stream from the master seed chain,
+     * host.c:176); rand()/random()/getrandom()//dev/urandom reads in
+     * the interposer all derive from this. ---- */
+    uint64_t (*rand_seed)(void* ctx);
 } ShimAPI;
 
 typedef int (*shim_main_fn)(const ShimAPI* api, int argc, char** argv);

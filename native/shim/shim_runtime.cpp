@@ -37,7 +37,9 @@
 
 #include <deque>
 #include <map>
+#include <set>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -166,6 +168,7 @@ struct Endpoint {
      * reports delivery (or are pruned once undeliverably old — a
      * reliability-roll drop on the device leaves no tombstone) */
     bool is_udp = false;
+    bool bound = false; /* explicit/implicit bind done (re-bind = EINVAL) */
     int64_t udp_seq = 0;                 /* next outgoing datagram seq */
     std::map<int64_t, OutDgram> udp_out; /* in-flight, awaiting delivery */
     std::deque<Datagram> udp_in;         /* delivered, awaiting recvfrom */
@@ -239,7 +242,13 @@ struct Runtime {
      * for a whole simulation, exactly like the reference's DNS registry
      * (src/main/routing/dns.c) */
     std::map<std::string, uint32_t> dns;
+    /* per-(host, port) bound-port registries, one per protocol space
+     * (the reference's Host tracks its own port table the same way,
+     * host.c boundSockets; EADDRINUSE comes from here) */
+    std::set<std::pair<int32_t, int32_t>> tcp_ports;
+    std::set<std::pair<int32_t, int32_t>> udp_ports;
     int32_t next_eph_port = 40000; /* ephemeral listen ports (bind :0) */
+    uint64_t sim_seed = 0xC0FFEE; /* driver-pushed (shim_set_seed) */
     int next_fd = kFirstFd;        /* global shim-fd counter */
     ShimAPI api{}; /* stable vtable handed to per-namespace interposers */
     uint64_t generation = 0; /* assigned on first make_api (v8 token) */
@@ -383,6 +392,11 @@ int api_close(void* vctx, int fd) {
     auto it = p->fds.find(fd);
     if (it == p->fds.end()) return -1;
     it->second.closed = true;
+    if (it->second.bound) { /* release the (host, port) registration */
+        (it->second.is_udp ? rt->udp_ports : rt->tcp_ports)
+            .erase({p->host, it->second.local_port});
+        it->second.bound = false;
+    }
     if (it->second.is_pipe) {
         auto peer = p->fds.find(it->second.pipe_peer);
         if (peer != p->fds.end()) {
@@ -527,12 +541,18 @@ int api_poll_fds(void* vctx, const int* fds, int nfds, int64_t timeout_ns) {
 
 /* -------------------------------------------------- v2: interposer api */
 
+/* bind results: >0 = bound port; -1 = bad fd (EBADF); -2 = port taken
+ * on this host (EADDRINUSE); -3 = socket already bound (EINVAL) */
 int api_bind(void* vctx, int fd, int port) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
     auto it = p->fds.find(fd);
     if (it == p->fds.end()) return -1;
+    if (it->second.bound) return -3;
+    if (port != 0 && rt->tcp_ports.count({p->host, port})) return -2;
     if (port == 0) port = rt->next_eph_port++;
+    rt->tcp_ports.insert({p->host, port});
+    it->second.bound = true;
     it->second.local_port = port;
     return port;
 }
@@ -683,16 +703,26 @@ int api_udp_socket(void* vctx) {
 /* bind the datagram socket into the device stack's demux table
  * (udp.c:26-60 association semantics); port 0 allocates an ephemeral
  * one. Returns the bound port. Re-binding is idempotent per fd. */
-int api_udp_bind(void* vctx, int fd, int port) {
+/* same result contract as api_bind; implicit (port-0 auto) binds from
+ * the send path pass explicit=0 and stay idempotent */
+int api_udp_bind2(void* vctx, int fd, int port, int explicit_bind) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     Proc* p = rt->current;
     auto it = p->fds.find(fd);
     if (it == p->fds.end() || !it->second.is_udp) return -1;
+    if (it->second.bound && explicit_bind) return -3;
     if (it->second.local_port) return it->second.local_port;
+    if (port != 0 && rt->udp_ports.count({p->host, port})) return -2;
     if (port == 0) port = rt->next_eph_port++;
+    rt->udp_ports.insert({p->host, port});
+    it->second.bound = true;
     it->second.local_port = port;
     push_req(rt, p->pid, REQ_UDP_BIND, fd, port, 0, nullptr);
     return port;
+}
+
+int api_udp_bind(void* vctx, int fd, int port) {
+    return api_udp_bind2(vctx, fd, port, 0);
 }
 
 int64_t api_udp_sendto(void* vctx, int fd, uint32_t ip, int port,
@@ -818,6 +848,22 @@ const char* api_env_get(void* vctx, const char* name) {
 const char* api_host_name(void* vctx) {
     Runtime* rt = static_cast<Runtime*>(vctx);
     return rt->current ? rt->current->host_name.c_str() : "";
+}
+
+/* per-process deterministic seed: the driver's simulation seed chained
+ * through (host, pid) with a splitmix64 finalizer — the reference's
+ * master->slave->host rand_r seed hierarchy (random.c:15-50,
+ * host.c:176) re-expressed as one keyed hash */
+uint64_t api_rand_seed(void* vctx) {
+    Runtime* rt = static_cast<Runtime*>(vctx);
+    Proc* p = rt->current;
+    uint64_t x = rt->sim_seed
+                 ^ (static_cast<uint64_t>(p ? p->host : 0) * 0x9E3779B97F4A7C15ULL)
+                 ^ (static_cast<uint64_t>(p ? p->pid : 0) << 32);
+    x += 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    return x ^ (x >> 31);
 }
 
 /* -------------------------------------------------- v4: pthread shim */
@@ -998,6 +1044,8 @@ ShimAPI make_api(Runtime* rt) {
     static uint64_t next_generation = 1;
     if (rt->generation == 0) rt->generation = next_generation++;
     a.generation = rt->generation;
+    a.udp_bind2 = api_udp_bind2;
+    a.rand_seed = api_rand_seed;
     return a;
 }
 
@@ -1119,6 +1167,12 @@ void* shim_init(void) {
 void shim_dns_add(void* vrt, const char* name, uint32_t ip) {
     Runtime* rt = static_cast<Runtime*>(vrt);
     if (name) rt->dns[name] = ip;
+}
+
+/* Driver-pushed simulation seed: the root of every virtual process's
+ * deterministic rand()/urandom stream (api_rand_seed). */
+void shim_set_seed(void* vrt, int64_t seed) {
+    static_cast<Runtime*>(vrt)->sim_seed = static_cast<uint64_t>(seed);
 }
 
 void shim_free(void* vrt) {
@@ -1316,7 +1370,9 @@ int shim_pump(void* vrt, int64_t now_ns, const ShimComp* comps, int n_comps,
             }
             case COMP_ACCEPT: {
                 int child = static_cast<int>(c.r0);
-                p->fds[child]; /* create the endpoint */
+                /* an accepted child is established by definition —
+                 * conn_status/shutdown must not read it as unconnected */
+                p->fds[child].conn = 1;
                 auto it = p->fds.find(c.fd);
                 if (it != p->fds.end()) {
                     it->second.accept_queue.push_back(child);

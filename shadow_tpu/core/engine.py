@@ -19,20 +19,26 @@ next-event times (`lax.pmin` across the device mesh when sharded). One
 "round" of the reference's pthread barrier dance is one iteration of the
 outer while loop here — no locks, no threads, no barrier waits.
 
-Drain algorithm (v2, batched): each outer iteration extracts every host's
-frontier — its `drain_batch` earliest below-barrier events, in
-(time, src, seq) order, via one multi-key `lax.sort` of the queue rows —
-then an inner while_loop executes frontier positions one at a time across
-all hosts (vmapped), buffering emitted events. Routed pushes and the
-cross-shard exchange run once per outer iteration instead of once per
-event, which amortizes the sort/scatter cost over the whole batch. The
-reference's per-host drain semantics (pop everything below the barrier,
-scheduler_policy_host_single.c:210-271) are preserved exactly: a host
-stops executing its frontier early only when an event it just emitted
-could precede a remaining frontier event in the total order — the next
-outer iteration then re-sorts and continues. Because cross-host sends are
-clamped to the window barrier, the inner loop needs no collectives, so
-each shard drains with its own trip count and only the outer loop
+Drain algorithm (v3, chained): each outer iteration (sweep) moves every
+host's frontier — its `drain_batch` earliest below-barrier events, a
+prefix of the key-sorted queue rows — into a per-host STAGING buffer,
+then an inner while_loop executes, per iteration, each host's minimum-key
+staged event (vmapped) and appends the handler's routed emits back into
+the staging buffer with one-hot masked writes (no sort, no scatter).
+Because cross-host sends are clamped to the window barrier, an emitted
+event is below the barrier iff it is LOCAL — so chains of local
+follow-ups (packet arrival -> rx delivery -> tx kick) execute inside ONE
+sweep in exact (time, src, seq) order, instead of costing one full
+queue-push + re-sort sweep per cascade level (the v2 bottleneck: TCP
+workloads measured ~2 events/sweep, ~48 sweeps/window). The sweep ends
+when no staged event is below the barrier; leftovers (clamped remote
+sends, far-future timers, high-water overflow) are flushed to the queues
+in one push + cross-shard exchange per sweep. The reference's per-host
+drain semantics (pop everything below the barrier,
+scheduler_policy_host_single.c:210-271) are preserved exactly — the
+per-host execution order is identical to v2's, which makes v3
+bit-compatible with v2 — and the inner loop still needs no collectives,
+so each shard drains with its own trip count and only the outer loop
 synchronizes.
 """
 
@@ -50,6 +56,7 @@ from shadow_tpu.core.events import (
     EventQueue,
     Events,
     group_run_starts,
+    pack_srcseq,
     queue_push,
 )
 from shadow_tpu.core.timebase import TIME_INVALID
@@ -184,6 +191,7 @@ class EngineConfig:
     n_shards: int = 1  # static mesh axis size (1 when unsharded)
     drain_batch: int = 32  # B: frontier events extracted per host per sweep
     route_bucket: int = 0  # per-peer all_to_all bucket slots (0 = auto)
+    stage_width: int = 0  # staging slots per host (0 = auto: B + 4K)
 
     def __post_init__(self):
         # a window of width 0 can never drain an event: the compiled outer
@@ -198,6 +206,21 @@ class EngineConfig:
             raise ValueError(
                 f"route_bucket must be >= 0, got {self.route_bucket}"
             )
+        if self.stage_width and self.stage_width < self.eff_drain_batch + self.max_emit:
+            # staging must hold a full frontier dump plus one handler's
+            # emits, or the chained drain could stall with zero headroom
+            raise ValueError(
+                f"stage_width {self.stage_width} < drain_batch "
+                f"{self.eff_drain_batch} + max_emit {self.max_emit}"
+            )
+
+    @property
+    def eff_drain_batch(self) -> int:
+        return max(1, min(self.drain_batch, self.capacity))
+
+    @property
+    def eff_stage_width(self) -> int:
+        return self.stage_width or (self.eff_drain_batch + 4 * self.max_emit)
 
 
 def _kind_cost(cpu_cost: jax.Array, kind: jax.Array) -> jax.Array:
@@ -472,8 +495,7 @@ class Engine:
         """Run handlers for one event per host (masked), route the emits.
 
         Returns (hosts', src_seq', exec_cnt', stats', routed Events[H, K],
-        final_mask[H, K], local_below[H, K] times of local emits below the
-        barrier for the frontier-safety check).
+        final_mask[H, K]).
         """
         cfg = self.cfg
         h, k = cfg.n_hosts, cfg.max_emit
@@ -503,11 +525,8 @@ class Engine:
         seq = src_seq[:, None] + within
         src_seq = src_seq + jnp.sum(inc, axis=1, dtype=jnp.int32)
 
-        out, final_mask, dropped, t, is_local = self._route(
+        out, final_mask, dropped, _t, _is_local = self._route(
             emit, ev.time, gids, window_end, rkeys, emask, seq
-        )
-        local_below = jnp.where(
-            final_mask & is_local & (t < window_end), t, TIME_INVALID
         )
 
         exec_cnt = exec_cnt + active.astype(jnp.int32)
@@ -524,7 +543,7 @@ class Engine:
                 * active[:, None]
             ),
         )
-        return hosts, src_seq, exec_cnt, stats, out, final_mask, local_below
+        return hosts, src_seq, exec_cnt, stats, out, final_mask
 
     # -- commutative fast path: whole frontiers in one vmapped call ---------
     def _drain_window_batched(self, st: EngineState, window_end, host0):
@@ -658,16 +677,86 @@ class Engine:
             cpu_free=cpu_free,
         )
 
+    # -- staging-buffer helpers (chained drain) ------------------------------
+    @staticmethod
+    def _stage_min(stage: Events):
+        """Per host, the minimum-(time, src, seq) staged event.
+
+        Returns (ev: Events with [H] fields, mss i64[H] the packed
+        (src, seq) key of that event — the total-order guard consumes
+        it, onehot bool[H, S] selecting its slot, valid_cnt i32[H]).
+        Empty rows yield time=TIME_INVALID. All elementwise/reduction
+        work — computed-index gathers and scatters serialize on TPU,
+        one-hot select is VPU-cheap.
+        """
+        t = stage.time
+        s = t.shape[1]
+        i64max = jnp.iinfo(jnp.int64).max
+        mt = jnp.min(t, axis=1)  # [H]
+        cand = t == mt[:, None]
+        ss = pack_srcseq(stage.src, stage.seq)
+        ssm = jnp.where(cand, ss, i64max)
+        mss = jnp.min(ssm, axis=1)
+        sel = cand & (ssm == mss[:, None])
+        first = jnp.argmax(sel, axis=1)  # (time, src, seq) is unique
+        onehot = jnp.arange(s, dtype=jnp.int32)[None, :] == first[:, None]
+        # dtype pinned: a bare int32 jnp.sum promotes to int64 under x64,
+        # which would leak wider event fields into every handler trace
+        pick32 = lambda a: jnp.sum(
+            jnp.where(onehot, a, 0), axis=1, dtype=a.dtype
+        )
+        ev = Events(
+            time=mt,
+            dst=pick32(stage.dst),
+            src=pick32(stage.src),
+            seq=pick32(stage.seq),
+            kind=pick32(stage.kind),
+            args=jnp.sum(
+                jnp.where(onehot[:, :, None], stage.args, 0), axis=1,
+                dtype=stage.args.dtype,
+            ),
+        )
+        valid_cnt = jnp.sum(t != TIME_INVALID, axis=1, dtype=jnp.int32)
+        return ev, mss, onehot, valid_cnt
+
+    @staticmethod
+    def _stage_append(stage: Events, out: Events, n_args: int):
+        """Append a routed [H, K] emit batch into each host's free staging
+        slots: one row-wise validity sort over [H, S + K] compacts valid
+        entries to the front and truncates (only) empty slots off the
+        tail — the caller's high-water gate guarantees the valid count
+        fits in S. A single sort HLO replaces K rounds of
+        find-free-slot/masked-write (the drain's per-step cost is op
+        COUNT at small host counts, not bandwidth). Slot order inside
+        staging is irrelevant: _stage_min selects by content key.
+        """
+        s = stage.time.shape[1]
+        cat = lambda a, b: jnp.concatenate([a, b], axis=1)
+        t = cat(stage.time, out.time)
+        vkey = (t == TIME_INVALID).astype(jnp.int32)
+        _vk, t2, dst2, src2, seq2, kind2, *acols = jax.lax.sort(
+            (vkey, t, cat(stage.dst, out.dst), cat(stage.src, out.src),
+             cat(stage.seq, out.seq), cat(stage.kind, out.kind),
+             *[cat(stage.args[:, :, i], out.args[:, :, i])
+               for i in range(n_args)]),
+            dimension=1, num_keys=1,
+        )
+        return Events(
+            time=t2[:, :s], dst=dst2[:, :s], src=src2[:, :s],
+            seq=seq2[:, :s], kind=kind2[:, :s],
+            args=jnp.stack([a[:, :s] for a in acols], axis=-1),
+        )
+
     # -- window = drain all events below the barrier ------------------------
     def _drain_window(self, st: EngineState, window_end, host0):
         if self.batch_handler is not None:
             return self._drain_window_batched(st, window_end, host0)
         cfg = self.cfg
         h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
-        b = max(1, min(cfg.drain_batch, c))
+        b = cfg.eff_drain_batch
+        sw = max(cfg.eff_stage_width, b + k)
         gids = host0 + jnp.arange(h, dtype=jnp.int32)
         cpu_cost = self.cpu_cost[gids]  # [H, NK] this shard's costs
-        i64max = jnp.iinfo(jnp.int64).max
 
         def outer_cond(carry):
             q, cpu_free = carry[0], carry[5]
@@ -681,51 +770,104 @@ class Engine:
         def outer_body(carry):
             q, hosts, src_seq, exec_cnt, stats, cpu_free = carry
 
-            # frontier extraction: queue rows are sorted by (time, src, seq)
-            # with empties last (events.py invariant), so each host's b
-            # earliest below-barrier events are simply its first b columns
-            bt = q.time[:, :b]
-            bsrc, bseq = q.src[:, :b], q.seq[:, :b]
-            bkind, bargs = q.kind[:, :b], q.args[:, :b]
-            bvalid = bt < window_end
+            # 1. move the frontier into staging: queue rows are sorted by
+            # (time, src, seq) with empties last (events.py invariant), so
+            # each host's b earliest below-barrier events are its first b
+            # columns, and clearing them is a prefix compare — no scatter.
+            bvalid = q.time[:, :b] < window_end  # a prefix of each row
+            ndump = jnp.sum(bvalid, axis=1, dtype=jnp.int32)
+            pad = ((0, 0), (0, sw - b))
+            stage = Events(
+                time=jnp.pad(
+                    jnp.where(bvalid, q.time[:, :b], TIME_INVALID),
+                    pad, constant_values=TIME_INVALID,
+                ),
+                dst=jnp.pad(jnp.broadcast_to(gids[:, None], (h, b)), pad),
+                src=jnp.pad(q.src[:, :b], pad),
+                seq=jnp.pad(q.seq[:, :b], pad),
+                kind=jnp.pad(q.kind[:, :b], pad),
+                args=jnp.pad(q.args[:, :b], (*pad, (0, 0))),
+            )
+            cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < ndump[:, None]
+            q = dataclasses.replace(
+                q, time=jnp.where(cleared, TIME_INVALID, q.time)
+            )
 
-            # emit buffer: routed events from every frontier position
-            ebuf = Events.empty((b, h, k), n_args=cfg.n_args)
-            emask0 = jnp.zeros((b, h, k), bool)
-            executed0 = jnp.zeros((b, h), bool)
+            # queue-head guard: the first UN-dumped event's key, per host
+            # (rows keep a sorted tail after the prefix clear, so it sits
+            # at column ndump; i64max when the row is exhausted). A staged
+            # event may only execute while its key precedes this — an
+            # event beyond the b-column dump could still be due first, and
+            # executing around it would break the (time, src, seq) total
+            # order. The queue is untouched mid-sweep, so this is constant
+            # per sweep.
+            i64max = jnp.iinfo(jnp.int64).max
+            headsel = (
+                jnp.arange(c, dtype=jnp.int32)[None, :] == ndump[:, None]
+            )
+            qh_t = jnp.min(jnp.where(headsel, q.time, i64max), axis=1)
+            qh_ss = jnp.min(
+                jnp.where(
+                    headsel & (q.time != TIME_INVALID),
+                    pack_srcseq(q.src, q.seq), i64max,
+                ),
+                axis=1,
+            )
 
+            def precede_q(ev_t, ev_ss):
+                return (ev_t < qh_t) | ((ev_t == qh_t) & (ev_ss < qh_ss))
+
+            def can_run(sm, cpu_free):
+                """Any host with a below-barrier staged event that precedes
+                the un-dumped queue head, CPU permitting, with append
+                headroom for one more handler invocation. `sm` is a
+                precomputed _stage_min result — it is carried through the
+                loop so each iteration pays the [H, S] min-key selection
+                exactly once."""
+                ev, mss, _oh, cnt = sm
+                mt = ev.time
+                eff = jnp.maximum(mt, cpu_free) if self._cpu_enabled else mt
+                return jnp.any(
+                    (eff < window_end) & precede_q(mt, mss) & (cnt + k <= sw)
+                )
+
+            # 2. chained execution: per iteration every host runs its
+            # minimum staged event; emits append back into staging, so
+            # same-window local follow-up chains run without another
+            # sweep. Remote sends are barrier-clamped, hence never
+            # below-barrier — they park in staging until the flush.
             def inner_cond(ic):
-                bi, min_emit, cpu_free = ic[0], ic[5], ic[9]
-                col = jax.lax.dynamic_index_in_dim(bt, bi, 1, keepdims=False)
-                vcol = jax.lax.dynamic_index_in_dim(bvalid, bi, 1, keepdims=False)
-                eff = jnp.maximum(col, cpu_free) if self._cpu_enabled else col
-                runnable = vcol & (col < min_emit) & (eff < window_end)
-                return (bi < b) & jnp.any(runnable)
+                return ic[0]
 
             def inner_body(ic):
-                (bi, hosts, src_seq, exec_cnt, stats, min_emit, ebuf, emask,
-                 executed, cpu_free) = ic
-                col = lambda a: jax.lax.dynamic_index_in_dim(a, bi, 1, keepdims=False)
-                ev_t = col(bt)
-                # the event runs when both it and the virtual CPU are due;
-                # past the barrier it stays queued for a later window
+                _, sm, stage, hosts, src_seq, exec_cnt, stats, cpu_free = ic
+                ev, mss, onehot, cnt = sm
+                ev_t = ev.time
                 eff_t = (
                     jnp.maximum(ev_t, cpu_free) if self._cpu_enabled else ev_t
                 )
                 active = (
-                    col(bvalid) & (ev_t < min_emit) & (eff_t < window_end)
+                    (ev_t != TIME_INVALID)
+                    & (eff_t < window_end)
+                    & precede_q(ev_t, mss)
+                    & (cnt + k <= sw)  # high-water: leftovers flush
                 )
-                ev = Events(
+                stage = dataclasses.replace(
+                    stage,
+                    time=jnp.where(
+                        onehot & active[:, None], TIME_INVALID, stage.time
+                    ),
+                )
+                ev = dataclasses.replace(
+                    ev,
                     time=jnp.where(active, eff_t, TIME_INVALID),
                     dst=gids,
-                    src=col(bsrc),
-                    seq=col(bseq),
-                    kind=col(bkind),
-                    args=col(bargs),
                 )
-                (hosts, src_seq, exec_cnt, stats, out, fmask,
-                 local_below) = self._execute_step(
-                    hosts, src_seq, exec_cnt, stats, ev, active, window_end, gids
+                hosts, src_seq, exec_cnt, stats, out, _fmask = (
+                    self._execute_step(
+                        hosts, src_seq, exec_cnt, stats, ev, active,
+                        window_end, gids,
+                    )
                 )
                 if self._cpu_enabled:
                     ev_cost = _kind_cost(cpu_cost, ev.kind)
@@ -733,38 +875,74 @@ class Engine:
                         active & (ev_cost > 0), eff_t + ev_cost,
                         cpu_free,
                     )
-                upd = lambda buf, x: jax.lax.dynamic_update_index_in_dim(buf, x, bi, 0)
-                ebuf = jax.tree.map(upd, ebuf, out)
-                emask = upd(emask, fmask)
-                executed = upd(executed, active)
-                min_emit = jnp.minimum(min_emit, jnp.min(local_below, axis=1))
+                stage = self._stage_append(stage, out, cfg.n_args)
                 stats = dataclasses.replace(
                     stats, n_inner_steps=stats.n_inner_steps + 1
                 )
-                return (bi + 1, hosts, src_seq, exec_cnt, stats, min_emit,
-                        ebuf, emask, executed, cpu_free)
+                sm2 = self._stage_min(stage)
+                return (can_run(sm2, cpu_free), sm2, stage, hosts, src_seq,
+                        exec_cnt, stats, cpu_free)
 
-            (_, hosts, src_seq, exec_cnt, stats, _, ebuf, emask,
-             executed, cpu_free) = jax.lax.while_loop(
+            sm0 = self._stage_min(stage)
+            (_, _, stage, hosts, src_seq, exec_cnt, stats,
+             cpu_free) = jax.lax.while_loop(
                 inner_cond,
                 inner_body,
-                (jnp.int32(0), hosts, src_seq, exec_cnt, stats,
-                 jnp.full((h,), i64max, jnp.int64), ebuf, emask0, executed0,
-                 cpu_free),
+                (can_run(sm0, cpu_free), sm0, stage, hosts, src_seq,
+                 exec_cnt, stats, cpu_free),
             )
 
-            # executed frontier positions form a prefix of each row (the
-            # inner loop's active mask is monotone), so the clear is an
-            # elementwise column-index compare — no scatter. The push's row
-            # re-sort restores the sorted-rows invariant afterwards.
-            n_exec = jnp.sum(executed, axis=0, dtype=jnp.int32)  # [H]
-            cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < n_exec[:, None]
-            q = dataclasses.replace(
-                q, time=jnp.where(cleared, TIME_INVALID, q.time)
+            # 3. flush staging leftovers (clamped remote sends, far-future
+            # locals, high-water overflow) in one push + exchange. A
+            # row-wise key sort compacts valid entries to a prefix; the
+            # common case pushes only a narrow column slice (staged
+            # leftovers are few), with a full-width fallback when any
+            # host's count exceeds it — exact either way.
+            skey = pack_srcseq(stage.src, stage.seq)
+            t2, _ss2, dst2, src2, seq2, kind2, *acols = jax.lax.sort(
+                (stage.time, skey, stage.dst, stage.src, stage.seq,
+                 stage.kind,
+                 *[stage.args[:, :, i] for i in range(cfg.n_args)]),
+                dimension=1, num_keys=2,
             )
-            q, xr, nc = self._exchange_push(
-                q, ebuf.flatten(), emask.reshape(-1), host0
+            stage = Events(
+                time=t2, dst=dst2, src=src2, seq=seq2, kind=kind2,
+                args=jnp.stack(acols, axis=-1),
             )
+            w1 = min(sw, 16)
+            maxcnt = jnp.max(
+                jnp.sum(stage.time != TIME_INVALID, axis=1, dtype=jnp.int32)
+            )
+
+            def push_narrow(args):
+                q, stage = args
+                sl = jax.tree.map(lambda a: a[:, :w1], stage)
+                flat = sl.flatten()
+                return self._exchange_push(
+                    q, flat, flat.time != TIME_INVALID, host0
+                )
+
+            def push_full(args):
+                q, stage = args
+                flat = stage.flatten()
+                return self._exchange_push(
+                    q, flat, flat.time != TIME_INVALID, host0
+                )
+
+            if w1 == sw:
+                q, xr, nc = push_full((q, stage))
+            elif cfg.axis_name is not None:
+                # sharded: the exchange's collectives must run under a
+                # shard-uniform program, and maxcnt differs per shard —
+                # make the branch choice global
+                go_wide = self._gany(maxcnt > w1)
+                q, xr, nc = jax.lax.cond(
+                    go_wide, push_full, push_narrow, (q, stage)
+                )
+            else:
+                q, xr, nc = jax.lax.cond(
+                    maxcnt > w1, push_full, push_narrow, (q, stage)
+                )
             stats = dataclasses.replace(
                 stats,
                 n_sweeps=stats.n_sweeps + 1,

@@ -41,6 +41,18 @@ N_ARGS = 6
 # full-width fallback round.
 MERGE_W = 24
 
+# Hot-region width for the row-wise merge (step 4): when every row's
+# resident population plus the incoming block fits inside the first
+# HOT_C columns, the merge sorts only [H, HOT_C + W] and leaves the
+# (all-empty) tail untouched — exact, because the sorted-rows invariant
+# makes "population <= HOT_C" mean "all valid slots live in the first
+# HOT_C columns". Large-capacity TCP simulations size C for worst-case
+# bursts (a full receive window in flight) but hold far fewer resident
+# events in steady state, so this turns the dominant per-sweep sort from
+# O(C log^2 C) into O(HOT_C log^2 HOT_C) per row. Rows past the bound
+# fall back to the full-width merge (a lax.cond; no collectives inside).
+HOT_C = 128
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -383,33 +395,67 @@ def queue_push(
         )
         blk = lambda x: x[:nf].reshape(h, w)
 
-        ex_pay = pack_words(
-            [q.kind] + [q.args[:, :, i] for i in range(a)]
-        )  # each [H, C]
-        mt = jnp.concatenate([q.time, blk(t2)], axis=1)
-        mss = jnp.concatenate([pk(q.src, q.seq), blk(ss2)], axis=1)
-        mpay = [
-            jnp.concatenate([e, blk(g)], axis=1)
-            for e, g in zip(ex_pay, pay2)
-        ]
-        mt, mss, *mpay = jax.lax.sort(
-            (mt, mss, *mpay), dimension=1, num_keys=2
-        )
+        def row_merge(q, hc):
+            """Merge the incoming [H, w] block into the first `hc` queue
+            columns and truncate back to hc; columns >= hc ride along
+            untouched. Exact when every valid slot lives below hc (the
+            hot-branch predicate guarantees it; hc == c is the general
+            case, where the tail is empty by construction)."""
+            ex_pay = pack_words(
+                [q.kind[:, :hc]] + [q.args[:, :hc, i] for i in range(a)]
+            )  # each [H, hc]
+            mt = jnp.concatenate([q.time[:, :hc], blk(t2)], axis=1)
+            mss = jnp.concatenate(
+                [pk(q.src[:, :hc], q.seq[:, :hc]), blk(ss2)], axis=1
+            )
+            mpay = [
+                jnp.concatenate([e, blk(g)], axis=1)
+                for e, g in zip(ex_pay, pay2)
+            ]
+            mt, mss, *mpay = jax.lax.sort(
+                (mt, mss, *mpay), dimension=1, num_keys=2
+            )
 
-        over = jnp.sum(
-            mt[:, c:] != TIME_INVALID, axis=1, dtype=jnp.int32
+            over = jnp.sum(
+                mt[:, hc:] != TIME_INVALID, axis=1, dtype=jnp.int32
+            )
+            if count_tail:
+                over = over + jnp.maximum(count - lo - w, 0)
+            new_src, new_seq = unpk(mss[:, :hc])
+            words = unpack_words([p[:, :hc] for p in mpay], nw)
+            glue = lambda head, tail: jnp.concatenate([head, tail], axis=1)
+            return EventQueue(
+                time=glue(mt[:, :hc], q.time[:, hc:]),
+                src=glue(new_src, q.src[:, hc:]),
+                seq=glue(new_seq, q.seq[:, hc:]),
+                kind=glue(words[0], q.kind[:, hc:]),
+                args=jnp.concatenate(
+                    [jnp.stack(words[1:], axis=-1), q.args[:, hc:]], axis=1
+                ),
+                drops=q.drops + over,
+            )
+
+        if c < 2 * HOT_C:
+            return row_merge(q, c)
+        # hot-region fast path: all resident events in the first HOT_C
+        # columns AND guaranteed to still fit after admitting w more.
+        # (The engine's frontier prefix-clear leaves an INVALID prefix, so
+        # residency is checked structurally — any valid slot at or past
+        # HOT_C forces the full-width merge.)
+        n_res = jnp.max(
+            jnp.sum(q.time != TIME_INVALID, axis=1, dtype=jnp.int32)
         )
-        if count_tail:
-            over = over + jnp.maximum(count - lo - w, 0)
-        new_src, new_seq = unpk(mss[:, :c])
-        words = unpack_words([p[:, :c] for p in mpay], nw)
-        return EventQueue(
-            time=mt[:, :c],
-            src=new_src,
-            seq=new_seq,
-            kind=words[0],
-            args=jnp.stack(words[1:], axis=-1),
-            drops=q.drops + over,
+        tail_clear = ~jnp.any(q.time[:, HOT_C:] != TIME_INVALID)
+        # the cleared prefix can push residents past HOT_C even when the
+        # count fits; bound it by the worst-case prefix offset (<= the
+        # count of leading INVALIDs is unknown here, so rely on the
+        # structural tail check plus the post-merge fit guarantee)
+        hot_ok = tail_clear & (n_res + w <= HOT_C)
+        return jax.lax.cond(
+            hot_ok,
+            lambda q: row_merge(q, HOT_C),
+            lambda q: row_merge(q, c),
+            q,
         )
 
     w_full = min(c, m)

@@ -82,17 +82,21 @@ class SocketTable:
         exact = base & (self.peer_host == src_host) & (self.peer_port == src_port)
         wild = base & (self.peer_host == -1)
         score = exact.astype(jnp.int32) * 2 + wild.astype(jnp.int32)
-        best = jnp.argmax(score)
-        return jnp.where(score[best] > 0, best.astype(jnp.int32), jnp.int32(-1))
+        # max == score[argmax], without the computed-index gather (which
+        # serializes on TPU under vmap)
+        return jnp.where(
+            jnp.max(score) > 0, jnp.argmax(score).astype(jnp.int32),
+            jnp.int32(-1),
+        )
 
     def add_rx(self, slot, nbytes):
-        ok = slot >= 0
-        idx = jnp.where(ok, slot, 0)
-        add = jnp.where(ok, jnp.asarray(nbytes, jnp.int64), 0)
-        return dataclasses.replace(self, rx_bytes=self.rx_bytes.at[idx].add(add))
+        # one-hot masked add: computed-index scatters serialize on TPU
+        # under vmap; [S]-lane elementwise work does not
+        oh = (jnp.arange(self.rx_bytes.shape[0]) == slot) & (slot >= 0)
+        add = jnp.where(oh, jnp.asarray(nbytes, jnp.int64), 0)
+        return dataclasses.replace(self, rx_bytes=self.rx_bytes + add)
 
     def add_tx(self, slot, nbytes):
-        ok = slot >= 0
-        idx = jnp.where(ok, slot, 0)
-        add = jnp.where(ok, jnp.asarray(nbytes, jnp.int64), 0)
-        return dataclasses.replace(self, tx_bytes=self.tx_bytes.at[idx].add(add))
+        oh = (jnp.arange(self.tx_bytes.shape[0]) == slot) & (slot >= 0)
+        add = jnp.where(oh, jnp.asarray(nbytes, jnp.int64), 0)
+        return dataclasses.replace(self, tx_bytes=self.tx_bytes + add)

@@ -35,7 +35,7 @@ from shadow_tpu.core.events import Events
 from shadow_tpu.core.timebase import SECOND
 from shadow_tpu.host.sockets import PROTO_TCP, PROTO_UDP
 from shadow_tpu.transport.stack import N_PKT_ARGS
-from shadow_tpu.transport.tcp import ESTABLISHED, emit_concat
+from shadow_tpu.transport.tcp import ESTABLISHED, _put, _sel, emit_concat
 
 _I32 = jnp.int32
 _I64 = jnp.int64
@@ -202,7 +202,7 @@ class BitcoinModel:
         s = hs.app.pending.shape[0]
         out_slot = s - 1 - jnp.clip(i, 0, self.MAX_PEERS - 1)
         sk = hs.net.sockets
-        w = lambda a, v: a.at[out_slot].set(jnp.where(ok, v, a[out_slot]))
+        w = lambda a, v: _put(a, out_slot, v, ok)
         sk = dataclasses.replace(
             sk,
             proto=w(sk.proto, PROTO_TCP),
@@ -295,13 +295,9 @@ class BitcoinModel:
         app = dataclasses.replace(
             app,
             curr_dl=jnp.where(want, mblock, app.curr_dl),
-            pending=app.pending.at[ls].set(
-                jnp.where(want, mblock, app.pending[ls])
-            ),
-            target=app.target.at[ls].set(
-                jnp.where(
-                    want, app.dl_rx[ls] + g["blocksize"], app.target[ls]
-                )
+            pending=_put(app.pending, ls, mblock, want),
+            target=_put(
+                app.target, ls, _sel(app.dl_rx, ls) + g["blocksize"], want
             ),
         )
         hs = dataclasses.replace(hs2, app=app)
@@ -317,14 +313,16 @@ class BitcoinModel:
         # -- TCP bytes: accumulate; completion adopts + re-announces
         is_tcp_data = got & (pkt.proto == PROTO_TCP) & (pkt.length > 0)
         app = hs.app
-        dl2 = app.dl_rx.at[s].add(
-            jnp.where(is_tcp_data, pkt.length.astype(_I64), 0)
+        dl2 = app.dl_rx + jnp.where(
+            (jnp.arange(app.dl_rx.shape[0], dtype=_I32) == s) & is_tcp_data,
+            pkt.length.astype(_I64), 0,
         )
         complete = (
-            is_tcp_data & (app.pending[s] >= 0) & (dl2[s] >= app.target[s])
+            is_tcp_data & (_sel(app.pending, s) >= 0)
+            & (_sel(dl2, s) >= _sel(app.target, s))
         )
         new_best = jnp.where(
-            complete, jnp.maximum(app.best, app.pending[s]), app.best
+            complete, jnp.maximum(app.best, _sel(app.pending, s)), app.best
         )
         app = dataclasses.replace(
             app,
@@ -332,9 +330,7 @@ class BitcoinModel:
             best=new_best,
             t_best=jnp.where(complete, now, app.t_best),
             curr_dl=jnp.where(complete, -1, app.curr_dl),
-            pending=app.pending.at[s].set(
-                jnp.where(complete, -1, app.pending[s])
-            ),
+            pending=_put(app.pending, s, -1, complete),
         )
         hs = dataclasses.replace(hs, app=app)
         hs, em_inv = self._announce(hs, new_best, now, complete)

@@ -43,7 +43,7 @@ from shadow_tpu.core.events import Events
 from shadow_tpu.core.timebase import SECOND
 from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP
 from shadow_tpu.transport.stack import F_FIN, N_PKT_ARGS
-from shadow_tpu.transport.tcp import emit_concat
+from shadow_tpu.transport.tcp import _put, _sel, emit_concat
 
 _I32 = jnp.int32
 _I64 = jnp.int64
@@ -248,7 +248,7 @@ class TorModel:
 
         cs = hs.net.tcb.state.shape[0] - 1  # dedicated circuit slot (top)
         sk = hs.net.sockets
-        w = lambda a, v: a.at[cs].set(jnp.where(first, v, a[cs]))
+        w = lambda a, v: _put(a, cs, v, first)
         sk = dataclasses.replace(
             sk,
             proto=w(sk.proto, PROTO_TCP),
@@ -279,7 +279,7 @@ class TorModel:
 
         # ---------------- relay: forward bytes along the circuit
         is_relay = got & (app.role == ROLE_RELAY)
-        have_fwd = app.fwd[s] >= 0
+        have_fwd = _sel(app.fwd, s) >= 0
         # new inbound circuit conn: source port encodes the circuit
         cid = pkt.src_port - CIRC_PORT_BASE
         new_circ = is_relay & ~have_fwd & (cid >= 0) & (
@@ -301,9 +301,7 @@ class TorModel:
         can_open = new_circ & jnp.any(free)
 
         sk = hs.net.sockets
-        w = lambda a, v: a.at[out_slot].set(
-            jnp.where(can_open, v, a[out_slot])
-        )
+        w = lambda a, v: _put(a, out_slot, v, can_open)
         sk = dataclasses.replace(
             sk,
             proto=w(sk.proto, PROTO_TCP),
@@ -312,10 +310,8 @@ class TorModel:
             peer_port=w(sk.peer_port, nxt_port),
         )
         fwd = app.fwd
-        fwd = fwd.at[s].set(jnp.where(can_open, out_slot, fwd[s]))
-        fwd = fwd.at[jnp.where(can_open, out_slot, s)].set(
-            jnp.where(can_open, s, fwd[jnp.where(can_open, out_slot, s)])
-        )
+        fwd = _put(fwd, s, out_slot, can_open)
+        fwd = _put(fwd, out_slot, s, can_open)
         app = dataclasses.replace(
             app,
             fwd=fwd,
@@ -327,7 +323,7 @@ class TorModel:
         )
         hs, em_open = tcp.connect(stack, hs, out_slot, now, mask=can_open)
 
-        fwd_to = hs.app.fwd[s]
+        fwd_to = _sel(hs.app.fwd, s)
         do_fwd = is_relay & (fwd_to >= 0) & (dlen > 0)
         hs, em_fwd = tcp.send(hs, fwd_to, dlen, now, mask=do_fwd)
         do_close = is_relay & (fwd_to >= 0) & eof
@@ -338,11 +334,11 @@ class TorModel:
         is_server = got & (app.role == ROLE_SERVER)
         scid = jnp.clip(pkt.src_port - CIRC_PORT_BASE, 0,
                         g["hops"].shape[0] - 1)
-        prev = app.req_rx[s]
+        prev = _sel(app.req_rx, s)
         newr = prev + jnp.where(is_server, dlen, 0)
         n_req = (newr // REQ_BYTES - prev // REQ_BYTES).astype(_I64)
         app = dataclasses.replace(
-            app, req_rx=app.req_rx.at[s].set(newr)
+            app, req_rx=_put(app.req_rx, s, newr, got)
         )
         hs = dataclasses.replace(hs, app=app)
         reply = n_req * g["filesize"][scid]

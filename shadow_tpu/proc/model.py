@@ -23,7 +23,7 @@ from shadow_tpu.core.events import Events
 from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP, PROTO_UDP
 from shadow_tpu.transport.stack import F_FIN, N_PKT_ARGS
 from shadow_tpu.transport.tcp import LISTEN as TCP_LISTEN
-from shadow_tpu.transport.tcp import emit_concat
+from shadow_tpu.transport.tcp import _put, _sel, emit_concat
 
 _I32 = jnp.int32
 
@@ -120,7 +120,7 @@ class ProcTierModel:
         proto = jnp.where(
             is_ubind, PROTO_UDP, jnp.where(is_uclose, PROTO_NONE, PROTO_TCP)
         )
-        w = lambda a, v: a.at[slot].set(jnp.where(do_bind, v, a[slot]))
+        w = lambda a, v: _put(a, slot, v, do_bind)
         sk = dataclasses.replace(
             sk,
             proto=w(sk.proto, proto),
@@ -129,13 +129,9 @@ class ProcTierModel:
             peer_port=w(sk.peer_port, jnp.where(is_conn, ev.args[4], 0)),
         )
         tcb = hs.net.tcb
-        st_new = tcb.state.at[slot].set(
-            jnp.where(is_listen, TCP_LISTEN, tcb.state[slot])
-        )
+        st_new = _put(tcb.state, slot, TCP_LISTEN, is_listen)
         tcb = dataclasses.replace(tcb, state=st_new)
-        fin_clear = hs.app.fin_seen.at[slot].set(
-            jnp.where(do_bind, False, hs.app.fin_seen[slot])
-        )
+        fin_clear = _put(hs.app.fin_seen, slot, False, do_bind)
         hs = dataclasses.replace(
             hs,
             app=dataclasses.replace(hs.app, fin_seen=fin_clear),
@@ -161,7 +157,7 @@ class ProcTierModel:
         # drains each window (payload bytes move host-side by seq)
         is_udp = got & (pkt.proto == PROTO_UDP)
         idx = jnp.where(is_udp, app.udp_cnt % UDP_RING, 0)
-        wr = lambda a, v: a.at[idx].set(jnp.where(is_udp, v, a[idx]))
+        wr = lambda a, v: _put(a, idx, v, is_udp)
         app = dataclasses.replace(
             app,
             udp_cnt=app.udp_cnt + is_udp.astype(_I32),
@@ -178,12 +174,11 @@ class ProcTierModel:
         # lazy per-incarnation reset: if this slot's TCB was reused since
         # fin_seen was last written, the sticky EOF belongs to a previous
         # connection and must clear before this delivery is applied
-        cur_gen = hs.net.tcb.conn_gen[s]
-        stale = got & (app.fin_gen[s] != cur_gen)
-        fin0 = jnp.where(stale, False, app.fin_seen[s])
-        fin = app.fin_seen.at[s].set(jnp.where(eof, True, fin0))
-        fgen = app.fin_gen.at[s].set(
-            jnp.where(got, cur_gen, app.fin_gen[s])
+        cur_gen = _sel(hs.net.tcb.conn_gen, s)
+        stale = got & (_sel(app.fin_gen, s) != cur_gen)
+        fin0 = jnp.where(stale, False, _sel(app.fin_seen, s))
+        fin = _put(app.fin_seen, s, jnp.where(eof, True, fin0), got)
+        fgen = _put(app.fin_gen, s, cur_gen, got
         )
         hs = dataclasses.replace(
             hs, app=dataclasses.replace(app, fin_seen=fin, fin_gen=fgen)

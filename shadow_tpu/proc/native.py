@@ -186,6 +186,7 @@ class ShimRuntime:
         lib.shim_set_host_name.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
         ]
+        lib.shim_set_seed.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         self._lib = lib
         self._rt = lib.shim_init()
         self._req_buf = (ShimReq * max_reqs)()
@@ -213,6 +214,11 @@ class ShimRuntime:
     def set_host_name(self, pid: int, name: str) -> None:
         """Virtual hostname for gethostname/uname (dns.c name)."""
         self._lib.shim_set_host_name(self._rt, pid, name.encode())
+
+    def set_seed(self, seed: int) -> None:
+        """Simulation seed rooting every virtual process's deterministic
+        rand()/urandom stream (random.c:15-50 hierarchy)."""
+        self._lib.shim_set_seed(self._rt, seed)
 
     def pump(self, now_ns: int, comps: list[tuple]) -> list[ShimReq]:
         """comps: [(pid, op, fd, r0[, pad])] -> emitted requests."""

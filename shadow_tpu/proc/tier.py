@@ -110,6 +110,7 @@ class ProcessTier:
             tcp_child_slot_limit=self._child_limit, locality=locality,
         )
         self.rt = ShimRuntime()
+        self.rt.set_seed(seed)  # roots plugin rand()/urandom determinism
         self.lost_stream_bytes = 0  # bytes unflushable at endpoint drop
         self.n_sockets = n_sockets
         # the interposer's getaddrinfo resolves against the runtime's DNS
@@ -187,7 +188,11 @@ class ProcessTier:
             a.host_id: a.ip for a in self.sim.dns.entries()
         }
 
-        h_n = len(self.sim.names)
+        # device arrays may be shape-bucketed wider than the real host
+        # count; the observe mirrors must match the DEVICE row dimension
+        # (padded rows stay inert/zero)
+        h_n = (self.sim.engine.cfg.n_hosts
+               * self.sim.engine.cfg.n_shards)
         self._prev_udp_cnt = np.zeros((h_n,), np.int32)
         self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
         self._prev_fin = np.zeros((h_n, n_sockets), bool)

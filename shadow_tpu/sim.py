@@ -408,6 +408,8 @@ def build_simulation(
     tcp_child_slot_limit: int | None = None,
     locality: bool = False,
     runahead_ns: int | None = None,
+    fuse_rx: bool = True,
+    shape_bucket: bool = True,
 ) -> Simulation:
     """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
 
@@ -440,6 +442,28 @@ def build_simulation(
         perm = locality_order(n_hosts, edges, int(mesh.devices.size))
         hosts = apply_order(hosts, perm)
 
+    # -- shape bucketing: pad the host dimension to a standard ladder so
+    # configs of nearby sizes COMPILE TO THE SAME XLA PROGRAM. Every
+    # distinct (n_hosts, n_sockets, capacity, ...) tuple is otherwise a
+    # fresh 6-8 minute compile on a cold TPU tunnel; padded hosts are
+    # inert (no processes, no events, default NICs), so they cost array
+    # rows but no event traffic. The ladder doubles up to 1024 rows and
+    # then steps by 1024 (bounded <=2x overhead below 1k hosts, <=10%
+    # above), always honoring mesh divisibility.
+    n_shards_req = int(mesh.devices.size) if mesh is not None else 1
+    if shape_bucket:
+        b_ = 16
+        while b_ < n_hosts:
+            b_ = b_ * 2 if b_ < 1024 else b_ + 1024
+        if b_ % n_shards_req:
+            b_ = ((b_ // n_shards_req) + 1) * n_shards_req
+        n_hosts = max(b_, n_hosts)
+    elif mesh is not None and n_hosts % n_shards_req:
+        raise ValueError(
+            f"{len(hosts)} hosts not divisible by mesh size "
+            f"{n_shards_req} (enable shape_bucket to auto-pad)"
+        )
+
     # -- attachment + DNS (master.c:307-345 registerHosts -> topology_attach,
     # dns_register)
     dns = DNS()
@@ -453,10 +477,13 @@ def build_simulation(
         )
         host_vertex.append(v)
         dns.register(h.gid, h.name, s.iphint or None)
+    # bucket-padded rows attach to vertex 0; they originate no traffic
+    host_vertex += [0] * (n_hosts - len(hosts))
 
     # -- NIC sizing: host attr overrides vertex attr (docs/3.1 host element)
-    bw_up = np.zeros((n_hosts,), np.float64)
-    bw_down = np.zeros((n_hosts,), np.float64)
+    # defaults also give bucket-padded rows sane (never-exercised) NICs
+    bw_up = np.full((n_hosts,), float(DEFAULT_BANDWIDTH_KIB), np.float64)
+    bw_down = np.full((n_hosts,), float(DEFAULT_BANDWIDTH_KIB), np.float64)
     cpu_cost = np.zeros((n_hosts,), np.int64)
     cpu_khz = np.zeros((n_hosts,), np.int64)  # for per-kind model charges
     rcv_wnd_bytes = np.zeros((n_hosts,), np.int64)
@@ -526,10 +553,12 @@ def build_simulation(
     if capacity is None:
         # every in-flight packet occupies a destination queue slot, so a
         # TCP host must hold a full receive window (64*WND_WORDS segs)
-        # plus timers/app events; non-TCP models need far less
+        # plus timers/app events; non-TCP models need far less. The +64
+        # headroom covers the fused rx path's earlier ACK clock (windows
+        # open sooner, so bursts overlap slightly more in flight).
         from shadow_tpu.transport.tcp import WND_WORDS
 
-        capacity = 64 * WND_WORDS * 2 if model.needs_tcp else 256
+        capacity = 64 * WND_WORDS * 2 + 64 if model.needs_tcp else 256
     net = HostNet.create(
         n_hosts, n_sockets, jnp.asarray(bw_up), jnp.asarray(bw_down),
         with_tcp=model.needs_tcp,
@@ -574,7 +603,13 @@ def build_simulation(
             child_slot_limit=tcp_child_slot_limit, **tcp_kw)
         if model.needs_tcp else None
     )
-    stack = Stack(bootstrap_end=bootstrap_end, tcp=tcp, rx_queue=rx_queue)
+    # fuse_rx folds the per-packet ARRIVE->RX double event into one
+    # (stack.py Stack docstring): output timing exact, state-read timing
+    # early by the rx serialization delay, half the sequential depth in
+    # the drain. On by default — the per-packet event pair is the
+    # dominant chain in every TCP workload.
+    stack = Stack(bootstrap_end=bootstrap_end, tcp=tcp, rx_queue=rx_queue,
+                  fuse_rx=fuse_rx)
 
     if on_recv is None:
         def on_recv(hs, slot, pkt, now, key):  # noqa: F811
@@ -704,15 +739,43 @@ def build_simulation(
     # the uniform per-event cost (the reference charges measured plugin
     # time per task, cpu.c:56-107 — per-kind tables are the jitted analog)
     cost_arg = cpu_cost
+    if fuse_rx and cpu_cost.any():
+        # the fused KIND_PKT_ARRIVE event executes the delivery too, so
+        # it pays BOTH halves of the uniform per-event charge — keeping
+        # CPU-model timing aligned with the unfused two-event pipeline.
+        # (Remaining documented divergence: a packet dropped at the rx
+        # queue still pays the delivery half here, where unfused mode
+        # would never execute its KIND_PKT_RX event.)
+        from shadow_tpu.transport.stack import KIND_PKT_ARRIVE
+
+        cost_arg = np.broadcast_to(
+            cpu_cost[:, None], (n_hosts, len(handlers))
+        ).copy()
+        cost_arg[:, KIND_PKT_ARRIVE] += cpu_cost
     if hasattr(model, "cpu_kind_cycles"):
         cycles = model.cpu_kind_cycles(len(handlers))
         if cycles is not None and cpu_khz.any():
+            if fuse_rx:
+                # deliveries execute inside KIND_PKT_ARRIVE when fused —
+                # move any per-delivery charge (e.g. Tor relay crypto at
+                # KIND_PKT_RX) onto the kind that actually runs, or the
+                # CPU model would silently stop charging it
+                from shadow_tpu.transport.stack import (
+                    KIND_PKT_ARRIVE, KIND_PKT_RX,
+                )
+
+                cycles = np.array(cycles, copy=True)
+                cycles[:, KIND_PKT_ARRIVE] += cycles[:, KIND_PKT_RX]
+                cycles[:, KIND_PKT_RX] = 0
             extra_ns = np.where(
                 cpu_khz[:, None] > 0,
                 cycles * 1_000_000 // np.maximum(cpu_khz[:, None], 1),
                 0,
             )
-            cost_arg = cpu_cost[:, None] + extra_ns
+            base = (
+                cost_arg if cost_arg.ndim == 2 else cpu_cost[:, None]
+            )
+            cost_arg = base + extra_ns
     eng = Engine(
         ecfg, handlers, network,
         cpu_cost=jnp.asarray(cost_arg) if cost_arg.any() else None,

@@ -33,6 +33,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from shadow_tpu.core import rng as srng
 from shadow_tpu.core.engine import Emit
 from shadow_tpu.core.events import Events
 from shadow_tpu.host.nic import HEADER_TCP, HEADER_UDP, MTU, NIC, CoDel
@@ -194,17 +195,32 @@ class Stack:
     """
 
     def __init__(self, *, bootstrap_end: int = 0, tcp=None,
-                 rx_queue: str = "codel"):
+                 rx_queue: str = "codel", fuse_rx: bool = False):
         """rx_queue selects the upstream router's queue manager
         (router.c:50-55 QUEUE_MANAGER_{CODEL,STATIC,SINGLE}): 'codel'
         (AQM, the reference host default, host.c:205), 'static' (pure
         drop-tail against the NIC buffer bound), or 'single' (one packet
-        queued at a time, router_queue_single.c)."""
+        queued at a time, router_queue_single.c).
+
+        fuse_rx=True folds the KIND_PKT_RX delivery into the
+        KIND_PKT_ARRIVE handler: one event per packet hop instead of
+        two. Every OUTPUT time is exact — the delivery's emits are
+        shifted by the rx-NIC serialization delay (finish - arrival), so
+        replies and relays leave at the same instants as the two-event
+        pipeline — but the socket/app STATE is read at arrival time
+        rather than at NIC-finish time, so another event executing
+        inside that (typically tens-of-microseconds) gap observes the
+        post-delivery state early. The reference always pays the
+        two-step path (network_interface.c:192-226 receive queue, then
+        socket demux); fusion is the TPU-era tradeoff that halves the
+        sequential depth of the engine's chained drain, where each step
+        costs a full handler-table pass."""
         if rx_queue not in ("codel", "static", "single"):
             raise ValueError(f"unknown rx_queue {rx_queue!r}")
         self.bootstrap_end = bootstrap_end  # unlimited-bandwidth phase end
         self.tcp = tcp  # TCP protocol hook (transport.tcp.TCP instance)
         self.rx_queue = rx_queue
+        self.fuse_rx = fuse_rx
 
     # ---------------------------------------------------------------- send
     def send_udp(self, hs, now, slot, dst_host, dst_port, nbytes,
@@ -223,7 +239,9 @@ class Stack:
         nic_tx = jax.tree.map(
             lambda n, o: jnp.where(mask, n, o), nic_tx, net.nic_tx
         )
-        sport = net.sockets.local_port[slot]
+        from shadow_tpu.transport.tcp import _sel
+
+        sport = _sel(net.sockets.local_port, slot)
         # socket counters track app payload; wire overhead is charged to
         # the NIC only (the reference's tracker splits payload vs header
         # bytes the same way, tracker.c:433-479)
@@ -347,18 +365,39 @@ class Stack:
                 ),
             )
             args = ev.args.at[A_SRC].set(ev.src)  # stash true source
-            em = Emit.single(
-                dst=ev.dst,
-                dt=finish - now,
-                kind=KIND_PKT_RX,
-                args=args,
-                mask=~drop,
-                local=True,
-                n_args=N_PKT_ARGS,
+            if not self.fuse_rx:
+                em = Emit.single(
+                    dst=ev.dst,
+                    dt=finish - now,
+                    kind=KIND_PKT_RX,
+                    args=args,
+                    mask=~drop,
+                    local=True,
+                    n_args=N_PKT_ARGS,
+                )
+                return hs, em
+            # fused delivery: run the rx path inline AT the NIC-finish
+            # instant (emits shift by finish - now, so all output timing
+            # matches the two-event pipeline); a dropped packet delivers
+            # nothing and leaves delivery state untouched. The delivery
+            # consumes an independent key stream so fused and unfused
+            # modes draw from separated domains.
+            rx_ev = dataclasses.replace(
+                ev, time=finish, args=args, kind=jnp.int32(KIND_PKT_RX)
+            )
+            hs2, em = deliver(hs, rx_ev, srng.fold_in(key, 0x52580001))
+            hs = jax.tree.map(
+                lambda dropped_v, ok_v: jnp.where(drop, dropped_v, ok_v),
+                hs, hs2,
+            )
+            em = dataclasses.replace(
+                em,
+                dt=em.dt + (finish - now),
+                mask=em.mask & ~drop,
             )
             return hs, em
 
-        def on_rx(hs, ev: Events, key):
+        def deliver(hs, ev: Events, key):
             # Socket demux + protocol dispatch (network_interface.c:375-455
             # -> udp_processPacket / tcp_processPacket).
             net: HostNet = hs.net
@@ -377,6 +416,16 @@ class Stack:
                 hs, net=dataclasses.replace(net, sockets=sockets)
             )
             return on_recv(hs, slot, pkt, ev.time, key)
+
+        def on_rx(hs, ev: Events, key):
+            if self.fuse_rx:
+                # deliveries ride inside on_arrive when fused; nothing
+                # emits KIND_PKT_RX events, but the branch still sits in
+                # the vmapped switch (every branch's ops execute masked),
+                # so it must be a stub, not a second copy of the delivery
+                # path
+                return hs, Emit.none(1, N_PKT_ARGS)
+            return deliver(hs, ev, key)
 
         handlers = [on_arrive, on_rx]
         if self.tcp is not None:

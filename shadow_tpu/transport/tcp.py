@@ -253,13 +253,59 @@ class TCB:
 
 
 def _row(tcb, c):
-    return jax.tree.map(lambda a: a[c], tcb)
+    """Read slot c's scalar row from a per-host [S, ...] TCB slice.
+
+    One-hot select, not `a[c]`: a computed-index gather under vmap
+    lowers to a serialized per-row gather on TPU — measured as the
+    dominant per-step cost of the chained drain at 1k hosts (~45 TCB
+    fields x several _row/_write_row calls per packet event). The
+    one-hot form is [S]-lane elementwise VPU work. Index semantics match
+    jax's clamp-to-range indexing via the clip."""
+
+    def pick(a):
+        s = a.shape[0]
+        cc = jnp.clip(c, 0, s - 1)
+        oh = jnp.arange(s, dtype=jnp.int32) == cc
+        ohx = oh.reshape((s,) + (1,) * (a.ndim - 1))
+        zero = jnp.zeros((), a.dtype)  # keeps bool/i64 fields their dtype
+        return jnp.sum(jnp.where(ohx, a, zero), axis=0, dtype=a.dtype)
+
+    return jax.tree.map(pick, tcb)
 
 
 def _write_row(tcb, c, new, mask):
-    return jax.tree.map(
-        lambda a, n: a.at[c].set(jnp.where(mask, n, a[c])), tcb, new
+    """Masked write of a scalar row into slot c (one-hot, scatter-free;
+    see _row)."""
+
+    def put(a, n):
+        s = a.shape[0]
+        cc = jnp.clip(c, 0, s - 1)
+        oh = (jnp.arange(s, dtype=jnp.int32) == cc) & mask
+        ohx = oh.reshape((s,) + (1,) * (a.ndim - 1))
+        return jnp.where(ohx, n, a)
+
+    return jax.tree.map(put, tcb, new)
+
+
+def _sel(a, c):
+    """Scalar read a[c] from a per-host [S] array, gather-free (one-hot
+    select; computed-index gathers serialize on TPU under vmap — see
+    _row). Out-of-range c clamps, matching jax indexing."""
+    s = a.shape[0]
+    cc = jnp.clip(c, 0, s - 1)
+    zero = jnp.zeros((), a.dtype)
+    return jnp.sum(
+        jnp.where(jnp.arange(s, dtype=_I32) == cc, a, zero), dtype=a.dtype
     )
+
+
+def _put(a, c, v, mask=True):
+    """Masked scalar write a[c] = v on a per-host [S] array (one-hot,
+    scatter-free; see _sel)."""
+    s = a.shape[0]
+    cc = jnp.clip(c, 0, s - 1)
+    oh = (jnp.arange(s, dtype=_I32) == cc) & mask
+    return jnp.where(oh, v, a)
 
 
 def _fresh_row_like(old: TCB) -> TCB:
@@ -392,22 +438,39 @@ def _bit_vec(off, w: int):
 
 
 def _bit_test(ooo, off):
-    """Is bit `off` set in the [W]-word bitmap? (off must be >= 0)."""
+    """Is bit `off` set in the [W]-word bitmap? (off must be >= 0).
+    One-hot select, not ooo[wi]: computed-index gathers serialize on
+    TPU under vmap (see _row)."""
     w = ooo.shape[0]
     wi = jnp.clip(off // 64, 0, w - 1)
     bi = jnp.clip(off - (off // 64) * 64, 0, 63).astype(jnp.uint64)
-    return ((ooo[wi] >> bi) & jnp.uint64(1)) != 0
+    word = jnp.sum(
+        jnp.where(jnp.arange(w, dtype=_I32) == wi, ooo, jnp.uint64(0)),
+        dtype=jnp.uint64,
+    )
+    return ((word >> bi) & jnp.uint64(1)) != 0
 
 
 def _shift_right_vec(ooo, adv):
-    """Shift a [W]-word bitmap right by `adv` bits (adv in [0, 64*W])."""
+    """Shift a [W]-word bitmap right by `adv` bits (adv in [0, 64*W]).
+    The word realignment is a one-hot [W, 2W+1] select instead of a
+    computed-index take (gather-free; see _row)."""
     w = ooo.shape[0]
     ws = adv // 64
     bs = jnp.clip(adv - ws * 64, 0, 63).astype(jnp.uint64)
     padded = jnp.concatenate([ooo, jnp.zeros((w + 1,), jnp.uint64)])
-    idx = jnp.arange(w, dtype=_I32) + ws
-    lo = jnp.take(padded, idx, mode="clip")
-    hi = jnp.take(padded, idx + 1, mode="clip")
+    j = jnp.arange(2 * w + 1, dtype=_I32)[None, :]
+    base = jnp.arange(w, dtype=_I32)[:, None] + ws
+
+    def take1(off_mat):
+        m = j == jnp.clip(off_mat, 0, 2 * w)
+        return jnp.sum(
+            jnp.where(m, padded[None, :], jnp.uint64(0)), axis=1,
+            dtype=jnp.uint64,
+        )
+
+    lo = take1(base)
+    hi = take1(base + 1)
     return (lo >> bs) | jnp.where(
         bs > 0, hi << (jnp.uint64(64) - bs), jnp.uint64(0)
     )
@@ -746,11 +809,11 @@ class TCP:
             lambda n, o: jnp.where(mask, n, o), nic2, net.nic_tx
         )
         syn = dict(
-            dst=net.sockets.peer_host[c],
+            dst=_sel(net.sockets.peer_host, c),
             dt=jnp.where(mask, fin_t - now, 0),
             kind=KIND_PKT_ARRIVE,
             args=_pkt_args(
-                net.sockets.local_port[c], net.sockets.peer_port[c],
+                _sel(net.sockets.local_port, c), _sel(net.sockets.peer_port, c),
                 wnd=row.rwnd, aux=_ts_us(now), flags=F_SYN,
             ),
             mask=mask, local=False,
@@ -804,14 +867,12 @@ class TCP:
         row = _row(net.tcb, c)
         lst = mask & (row.state == LISTEN)
         tcb = _write_row(net.tcb, c, _fresh_row_like(row), lst)
-        fp = tcb.fin_pending.at[c].set(
-            jnp.where(mask & ~lst, True, tcb.fin_pending[c])
-        )
+        fp = _put(tcb.fin_pending, c, True, mask & ~lst)
         tcb = dataclasses.replace(tcb, fin_pending=fp)
         # the listener's demux row clears too, so a later bind of the
         # same port cannot alias two socket rows
         sk = net.sockets
-        w = lambda a, v: a.at[c].set(jnp.where(lst, v, a[c]))
+        w = lambda a, v: _put(a, c, v, lst)
         sk = dataclasses.replace(
             sk, proto=w(sk.proto, 0), local_port=w(sk.local_port, 0)
         )
@@ -875,7 +936,7 @@ class TCP:
             timer_gen=child_old.timer_gen + 1,
             rto_deadline=now + RTO_INIT,
         )
-        wr = lambda a, v, m: a.at[child].set(jnp.where(m, v, a[child]))
+        wr = lambda a, v, m: _put(a, child, v, m)
         sockets = dataclasses.replace(
             sockets,
             proto=wr(sockets.proto, PROTO_TCP, do_open),
@@ -1206,9 +1267,9 @@ class TCP:
 
         # -- retransmit row (fast retransmit / NewReno partial ack)
         nic_tx = net.nic_tx
-        peer_h = sockets.peer_host[c]
-        peer_p = sockets.peer_port[c]
-        sport = sockets.local_port[c]
+        peer_h = _sel(sockets.peer_host, c)
+        peer_p = _sel(sockets.peer_port, c)
+        sport = _sel(sockets.local_port, c)
         retx_fin = _fin_ready(row) & (row.snd_una == n_segs)
         nic_tx, retx_row = self._seg_row(
             nic_tx, row, now, peer_h, sport, peer_p, row.snd_una, retx_fin,
@@ -1282,9 +1343,7 @@ class TCP:
             row,
         )
         sockets = dataclasses.replace(
-            sockets, proto=sockets.proto.at[c].set(
-                jnp.where(freed & is_tcp, PROTO_NONE, sockets.proto[c])
-            )
+            sockets, proto=_put(sockets.proto, c, PROTO_NONE, freed & is_tcp)
         )
 
         # -- write back: main row at c, child row at its slot
@@ -1330,12 +1389,12 @@ class TCP:
         now = ev.time
         c = jnp.maximum(ev.args[T_SLOT], 0)
         row = _row(net.tcb, c)
-        enabled = net.sockets.proto[c] == PROTO_TCP
+        enabled = _sel(net.sockets.proto, c) == PROTO_TCP
         unlimited = now < stack.bootstrap_end
         nic_tx, row, rows, more = self._tx_segments(
             net.nic_tx, row, now,
-            net.sockets.peer_host[c], net.sockets.local_port[c],
-            net.sockets.peer_port[c], self.tx_burst, enabled, unlimited,
+            _sel(net.sockets.peer_host, c), _sel(net.sockets.local_port, c),
+            _sel(net.sockets.peer_port, c), self.tx_burst, enabled, unlimited,
         )
         rows.append(self._kick_row(c, now, nic_tx.free_at, more))
         row, timer_row = self._arm_row(
@@ -1357,7 +1416,7 @@ class TCP:
         gen = ev.args[T_GEN]
         tk = ev.args[T_KIND]
         row = _row(net.tcb, c)
-        slot_ok = net.sockets.proto[c] == PROTO_TCP
+        slot_ok = _sel(net.sockets.proto, c) == PROTO_TCP
         live = (gen == row.timer_gen) & slot_ok
         unlimited = now < stack.bootstrap_end
 
@@ -1407,9 +1466,9 @@ class TCP:
         )
 
         # retransmission: SYN / SYN-ACK / data-or-FIN at snd_una
-        peer_h = net.sockets.peer_host[c]
-        peer_p = net.sockets.peer_port[c]
-        sport = net.sockets.local_port[c]
+        peer_h = _sel(net.sockets.peer_host, c)
+        peer_p = _sel(net.sockets.peer_port, c)
+        sport = _sel(net.sockets.local_port, c)
         is_syn_rtx = timeout & (row.state == SYN_SENT)
         is_synack_rtx = timeout & (row.state == SYN_RCVD)
         is_data_rtx = timeout & (row.state >= ESTABLISHED)
@@ -1469,9 +1528,7 @@ class TCP:
         )
         sockets = dataclasses.replace(
             net.sockets,
-            proto=net.sockets.proto.at[c].set(
-                jnp.where(tw_done, PROTO_NONE, net.sockets.proto[c])
-            ),
+            proto=_put(net.sockets.proto, c, PROTO_NONE, tw_done),
         )
         tcb = _write_row(net.tcb, c, row, live | is_da)
         hs = dataclasses.replace(

@@ -146,7 +146,7 @@ def test_ram_heartbeat_lines():
     ram = stats["ram"]
     assert set(ram) == {"server", "client"}
     r = ram["server"]
-    assert r["queue_capacity"][0] == 512
+    assert r["queue_capacity"][0] == 576
     assert r["sockets_capacity"][0] == 8
     assert 0 < r["sockets_used"][0] <= 8
     assert r["state_bytes"][0] > 1000

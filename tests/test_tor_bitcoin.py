@@ -137,7 +137,10 @@ def test_bitcoin_blocks_reach_every_node():
     # peers at once; 256 slots overflow (loudly) at this fan-out
     sim = build_simulation(cfg, seed=9, n_sockets=16, capacity=512)
     st = sim.run()
-    app = st.hosts.app
+    # device arrays may carry inert shape-bucket padding past the real
+    # host count; assertions address the real rows
+    n = len(sim.names)
+    app = jax.tree.map(lambda a: a[:n], st.hosts.app)
 
     assert app.best.tolist() == [blocks] * 8, (
         app.best.tolist(), app.curr_dl.tolist()
