@@ -720,31 +720,49 @@ class Engine:
         return ev, mss, onehot, valid_cnt
 
     @staticmethod
-    def _stage_append(stage: Events, out: Events, n_args: int):
+    def _stage_append(stage: Events, out: Events):
         """Append a routed [H, K] emit batch into each host's free staging
-        slots: one row-wise validity sort over [H, S + K] compacts valid
-        entries to the front and truncates (only) empty slots off the
-        tail — the caller's high-water gate guarantees the valid count
-        fits in S. A single sort HLO replaces K rounds of
-        find-free-slot/masked-write (the drain's per-step cost is op
-        COUNT at small host counts, not bandwidth). Slot order inside
-        staging is irrelevant: _stage_min selects by content key.
+        slots by RANK MATCHING: the j-th valid emit lands in the j-th
+        free slot (two cumsum rank scans + one [H, S, K] compare), all
+        elementwise — no sort, no scatter. The earlier implementation
+        sorted [H, S+K] x 16 operands per inner step, which profiled as
+        the drain's dominant per-iteration traffic at 1k hosts. The
+        caller's high-water gate guarantees at least K free slots, so
+        every valid emit matches exactly one slot. Slot arrangement is
+        irrelevant: _stage_min selects by content key.
         """
-        s = stage.time.shape[1]
-        cat = lambda a, b: jnp.concatenate([a, b], axis=1)
-        t = cat(stage.time, out.time)
-        vkey = (t == TIME_INVALID).astype(jnp.int32)
-        _vk, t2, dst2, src2, seq2, kind2, *acols = jax.lax.sort(
-            (vkey, t, cat(stage.dst, out.dst), cat(stage.src, out.src),
-             cat(stage.seq, out.seq), cat(stage.kind, out.kind),
-             *[cat(stage.args[:, :, i], out.args[:, :, i])
-               for i in range(n_args)]),
-            dimension=1, num_keys=1,
-        )
+        free = stage.time == TIME_INVALID  # [H, S]
+        fr = jnp.cumsum(free.astype(jnp.int32), axis=1) - free
+        valid = out.time != TIME_INVALID  # [H, K]
+        er = jnp.cumsum(valid.astype(jnp.int32), axis=1) - valid
+        match = (
+            (fr[:, :, None] == er[:, None, :])
+            & free[:, :, None]
+            & valid[:, None, :]
+        )  # [H, S, K]; at most one True per (row, slot) and per emit
+        hit = jnp.any(match, axis=2)
+
+        def put(cur, new):  # [H, S](, A) <- [H, K](, A)
+            zero = jnp.zeros((), new.dtype)
+            if cur.ndim == 2:
+                sel = jnp.sum(
+                    jnp.where(match, new[:, None, :], zero), axis=2,
+                    dtype=new.dtype,
+                )
+                return jnp.where(hit, sel, cur)
+            sel = jnp.sum(
+                jnp.where(match[..., None], new[:, None, :, :], zero),
+                axis=2, dtype=new.dtype,
+            )
+            return jnp.where(hit[..., None], sel, cur)
+
         return Events(
-            time=t2[:, :s], dst=dst2[:, :s], src=src2[:, :s],
-            seq=seq2[:, :s], kind=kind2[:, :s],
-            args=jnp.stack([a[:, :s] for a in acols], axis=-1),
+            time=put(stage.time, out.time),
+            dst=put(stage.dst, out.dst),
+            src=put(stage.src, out.src),
+            seq=put(stage.seq, out.seq),
+            kind=put(stage.kind, out.kind),
+            args=put(stage.args, out.args),
         )
 
     # -- window = drain all events below the barrier ------------------------
@@ -875,7 +893,7 @@ class Engine:
                         active & (ev_cost > 0), eff_t + ev_cost,
                         cpu_free,
                     )
-                stage = self._stage_append(stage, out, cfg.n_args)
+                stage = self._stage_append(stage, out)
                 stats = dataclasses.replace(
                     stats, n_inner_steps=stats.n_inner_steps + 1
                 )

@@ -412,6 +412,20 @@ class ProcessTier:
                 self.logs.append((now, pid, r.name.decode()))
             elif r.op == REQ_EXIT:
                 self.exit_codes[pid] = int(r.a0)
+                # a process that returns from main() with sockets still
+                # open gets the kernel-close semantics: FIN every driver
+                # endpoint it holds (the same sweep the stoptime-kill
+                # path runs) and free its datagram slots — without this
+                # its peers never see EOF and slot_of pins the
+                # all-exited early break open forever
+                for (p_pid, p_fd), (gid, slot) in list(self.slot_of.items()):
+                    if p_pid == pid:
+                        rows.append((gid, [CMD_CLOSE, slot]))
+                for key in [k for k in self.udp_eps if k[0] == pid]:
+                    gid, slot, port = self.udp_eps.pop(key)
+                    self.udp_port.pop((gid, port), None)
+                    self._free_slots.setdefault(gid, []).append(slot)
+                    rows.append((gid, [CMD_UDP_CLOSE, slot]))
         return rows
 
     # ------------------------------------------------------------- inject
@@ -653,6 +667,18 @@ class ProcessTier:
             st = self._inject(st, self._translate(reqs, now), now)
 
             if now >= stop_ns:
+                break
+            # every process has exited and no driver endpoint still owes
+            # a teardown handshake: the remaining horizon is dead time
+            # (the reference likewise ends when its process count hits
+            # zero before stoptime, master.c end-of-simulation path)
+            if (
+                self.exit_codes
+                and len(self.exit_codes) >= len(self.pid_host)
+                and not self._starts
+                and not self.slot_of
+                and not self.udp_eps
+            ):
                 break
             # never step past the next host-side interest point
             bound = stop_ns

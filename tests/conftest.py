@@ -49,3 +49,7 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.fspath.basename in slow_files:
             item.add_marker(pytest.mark.slow)
+        if item.fspath.basename == "test_ref_capstones.py":
+            # dedicated lane CI-gating the README's "reference test
+            # sources run unmodified" claim: `pytest -m capstone`
+            item.add_marker(pytest.mark.capstone)
