@@ -179,6 +179,21 @@ def _stamp(msg: str) -> None:
           flush=True)
 
 
+def _build_on_cpu(cfg, **kw):
+    """build_simulation with EAGER ops pinned to the host CPU, then one
+    transfer of the finished state to the accelerator. Building on the
+    axon device costs one tunnel round trip per eager op — measured 18
+    minutes for the 10k-host Tor shape vs 48 s this way."""
+    import jax
+
+    from shadow_tpu.sim import build_simulation
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        sim = build_simulation(cfg, **kw)
+    sim.state0 = jax.device_put(sim.state0, jax.devices()[0])
+    return sim
+
+
 def tor_worker():
     """Secondary metric: Tor-circuit workload (BASELINE configs 3-4) at
     the BENCH_TOR_TIER size. The relay-crypto CPU model (cycles per
@@ -201,8 +216,11 @@ def tor_worker():
     tier_idx = int(os.environ.get("BENCH_TOR_TIER", 0)) % len(TOR_TIERS)
     relays, clients, servers = TOR_TIERS[tier_idx]
     # measured horizon shrinks with tier size so every tier's timed run
-    # fits a per-round budget; sim-s/wall-s is horizon-independent
-    stop_s = (20, 10, 5, 3)[tier_idx]
+    # fits a per-round budget; sim-s/wall-s is horizon-independent.
+    # Tier 3 must reach past t=3: clients start staggered at 3 + i%20 s,
+    # so a shorter horizon measures an empty network (r05 first attempt:
+    # 0 events over 3 sim-s).
+    stop_s = (20, 10, 5, 6)[tier_idx]
     _stamp(f"tor tier {relays}/{clients}/{servers} cpu={with_cpu}: building")
     cfg = parse_config(tor_example(
         n_relays_per_class=relays, n_clients=clients,
@@ -210,7 +228,7 @@ def tor_worker():
         relay_cpu_ghz=3.0 if with_cpu else 0.0,
     ))
     runahead_ms = float(os.environ.get("BENCH_RUNAHEAD_MS", 0))
-    sim = build_simulation(
+    sim = _build_on_cpu(
         cfg, seed=1, n_sockets=48, capacity=768,
         runahead_ns=(
             int(runahead_ms * MILLISECOND) if runahead_ms > 0 else None
@@ -286,7 +304,7 @@ def btc_worker():
     cfg = parse_config(bitcoin_example(
         n_nodes=1000, blocks=2, blocksize="256KiB", interval=30,
     ))
-    sim = build_simulation(cfg, seed=1, n_sockets=16, capacity=768)
+    sim = _build_on_cpu(cfg, seed=1, n_sockets=16, capacity=768)
     sim.strict_overflow = False
     # 1-sim-s chunks: the 5-s chunks of r04 tripped the axon tunnel's
     # long-invocation deadline and crashed the TPU worker twice

@@ -66,7 +66,17 @@ ROLE_NONE, ROLE_RELAY, ROLE_CLIENT, ROLE_SERVER = 0, 1, 2, 3
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class TorApp:
-    """Per-host state ([H] / [H, S] at rest)."""
+    """Per-host state ([H] / [H, S] / [H, CM] at rest).
+
+    All circuit configuration is PER-HOST: a relay/server row carries its
+    own small [CM] list of (circuit id -> next hop / served filesize),
+    and a client row carries its own fetch parameters. Handlers therefore
+    never index a global [NC]-sized table — a per-host gather of such a
+    table serializes on TPU (and its [NC, 3] form tiles the trailing dim
+    to 128 lanes: a measured 35 GB intermediate at the 10k-host shape).
+    Every lookup here is a one-hot match over <=CM lanes, elementwise at
+    any host count.
+    """
 
     gid: jax.Array  # i32
     role: jax.Array  # i32
@@ -77,6 +87,32 @@ class TorApp:
     conn_rx: jax.Array  # i64 client: reply bytes on the circuit conn
     t_last_done: jax.Array  # i64
     relayed_bytes: jax.Array  # i64 relay observability
+    # client parameters (own row; -1 / 0 on non-clients)
+    circ_id: jax.Array  # i32 this client's circuit id
+    cl_guard: jax.Array  # i32 entry relay gid
+    cl_file: jax.Array  # i64 fetch size (bytes)
+    cl_count: jax.Array  # i32 fetches to run
+    cl_pause: jax.Array  # i64[4] think-time cycle
+    cl_npause: jax.Array  # i32 live entries in cl_pause
+    # relay/server circuit table (first-match wins, -1 = empty slot)
+    tc_cid: jax.Array  # i32[CM]
+    tc_nxt: jax.Array  # i32[CM] next-hop gid (relay rows)
+    tc_port: jax.Array  # i32[CM] next-hop port
+    tc_file: jax.Array  # i64[CM] served filesize (server rows)
+
+
+def _tc_lookup(app: TorApp, cid):
+    """(found, nxt_gid, nxt_port, filesize) for `cid` in this host's
+    circuit table — one-hot over [CM], no gathers."""
+    match = app.tc_cid == cid
+    # first match wins (duplicate-relay circuits in tiny pools)
+    first = jnp.cumsum(match.astype(_I32)) == 1
+    m = match & first
+    found = jnp.any(m)
+    pick = lambda a: jnp.sum(
+        jnp.where(m, a, jnp.zeros((), a.dtype)), dtype=a.dtype
+    )
+    return found, pick(app.tc_nxt), pick(app.tc_port), pick(app.tc_file)
 
 
 class TorModel:
@@ -187,17 +223,55 @@ class TorModel:
                 pause_ns[ci, j] = int(t * SECOND)
             n_pause[ci] = max(min(len(pauses), 4), 1)
 
-        self._g = dict(
-            hops=jnp.asarray(hops),
-            srv_gid=jnp.asarray(srv_gid),
-            srv_port=jnp.asarray(srv_port),
-            filesize=jnp.asarray(filesize),
-            count=jnp.asarray(count),
-            pause_ns=jnp.asarray(pause_ns),
-            n_pause=jnp.asarray(n_pause),
-            client_circ=jnp.asarray(client_circ),
-            or_port=jnp.int32(OR_PORT),
-        )
+        # flatten the circuit table into PER-HOST rows (TorApp docstring:
+        # global [NC] tables gathered per event serialize on TPU; these
+        # one-hot-matched [CM] rows stay elementwise at any scale).
+        # Each circuit contributes one entry to each of its three relays
+        # (next hop along the telescope) and one to its server (filesize
+        # to serve); first entry per (host, cid) wins, matching the old
+        # first-position-match semantics for duplicate-relay circuits.
+        per_host: dict[int, list[tuple[int, int, int, int]]] = {}
+        for ci in range(len(clients)):
+            g0, g1, g2 = int(hops[ci, 0]), int(hops[ci, 1]), int(hops[ci, 2])
+            chain = [
+                (g0, g1, OR_PORT, 0),
+                (g1, g2, OR_PORT, 0),
+                (g2, int(srv_gid[ci]), int(srv_port[ci]), 0),
+                (int(srv_gid[ci]), -1, 0, int(filesize[ci])),
+            ]
+            for gid_e, nxt, prt, fsz in chain:
+                per_host.setdefault(gid_e, []).append((ci, nxt, prt, fsz))
+        cm = 4
+        longest = max((len(v) for v in per_host.values()), default=1)
+        while cm < longest:
+            cm *= 2
+        if cm > 4096:
+            raise ValueError(
+                f"a relay/server participates in {longest} circuits; "
+                "per-host circuit tables cap at 4096 — add relays/servers"
+            )
+        tc_cid = np.full((n, cm), -1, np.int32)
+        tc_nxt = np.full((n, cm), -1, np.int32)
+        tc_port = np.zeros((n, cm), np.int32)
+        tc_file = np.zeros((n, cm), np.int64)
+        for gid_e, rowlist in per_host.items():
+            for j, (ci, nxt, prt, fsz) in enumerate(rowlist):
+                tc_cid[gid_e, j] = ci
+                tc_nxt[gid_e, j] = nxt
+                tc_port[gid_e, j] = prt
+                tc_file[gid_e, j] = fsz
+
+        cl_guard = np.full((n,), -1, np.int32)
+        cl_file = np.zeros((n,), np.int64)
+        cl_count = np.zeros((n,), np.int32)
+        cl_pause = np.full((n, 4), SECOND, np.int64)
+        cl_npause = np.ones((n,), np.int32)
+        for ci, (gid_c, _kv) in enumerate(clients):
+            cl_guard[gid_c] = hops[ci, 0]
+            cl_file[gid_c] = filesize[ci]
+            cl_count[gid_c] = count[ci]
+            cl_pause[gid_c] = pause_ns[ci]
+            cl_npause[gid_c] = n_pause[ci]
 
         self._role = role  # for the per-kind CPU table
 
@@ -212,6 +286,16 @@ class TorModel:
             conn_rx=jnp.zeros((n,), _I64),
             t_last_done=jnp.zeros((n,), _I64),
             relayed_bytes=jnp.zeros((n,), _I64),
+            circ_id=jnp.asarray(client_circ),
+            cl_guard=jnp.asarray(cl_guard),
+            cl_file=jnp.asarray(cl_file),
+            cl_count=jnp.asarray(cl_count),
+            cl_pause=jnp.asarray(cl_pause),
+            cl_npause=jnp.asarray(cl_npause),
+            tc_cid=jnp.asarray(tc_cid),
+            tc_nxt=jnp.asarray(tc_nxt),
+            tc_port=jnp.asarray(tc_port),
+            tc_file=jnp.asarray(tc_file),
         )
         return state, self._make_handlers, self._on_recv
 
@@ -237,12 +321,11 @@ class TorModel:
     # ------------------------------------------------- client fetch kind
     def _on_fetch(self, hs, ev: Events, key):
         """Open the circuit connection (first fetch) / issue a request."""
-        stack, tcp, g = self._stack, self._stack.tcp, self._g
+        stack, tcp = self._stack, self._stack.tcp
         app: TorApp = hs.app
-        me = app.gid
-        cid = g["client_circ"][me]
+        cid = app.circ_id
         is_client = (app.role == ROLE_CLIENT) & (cid >= 0)
-        ok = is_client & (app.streams_started < g["count"][jnp.maximum(cid, 0)])
+        ok = is_client & (app.streams_started < app.cl_count)
         cidc = jnp.maximum(cid, 0)
         first = ok & (app.streams_started == 0)
 
@@ -253,8 +336,8 @@ class TorModel:
             sk,
             proto=w(sk.proto, PROTO_TCP),
             local_port=w(sk.local_port, CIRC_PORT_BASE + cidc),
-            peer_host=w(sk.peer_host, g["hops"][cidc, 0]),
-            peer_port=w(sk.peer_port, g["or_port"]),
+            peer_host=w(sk.peer_host, app.cl_guard),
+            peer_port=w(sk.peer_port, jnp.int32(OR_PORT)),
         )
         app = dataclasses.replace(
             app, streams_started=app.streams_started + ok.astype(_I32)
@@ -269,9 +352,8 @@ class TorModel:
     # -------------------------------------------------------- deliveries
     def _on_recv(self, hs, slot, pkt, now, key):
         """Role dispatch on every delivered chunk/EOF."""
-        stack, tcp, g = self._stack, self._stack.tcp, self._g
+        stack, tcp = self._stack, self._stack.tcp
         app: TorApp = hs.app
-        me = app.gid
         got = slot >= 0
         s = jnp.maximum(slot, 0)
         eof = got & ((pkt.flags & F_FIN) != 0)
@@ -280,19 +362,14 @@ class TorModel:
         # ---------------- relay: forward bytes along the circuit
         is_relay = got & (app.role == ROLE_RELAY)
         have_fwd = _sel(app.fwd, s) >= 0
-        # new inbound circuit conn: source port encodes the circuit
+        # new inbound circuit conn: source port encodes the circuit;
+        # the next hop comes from this host's OWN [CM] circuit table
         cid = pkt.src_port - CIRC_PORT_BASE
-        new_circ = is_relay & ~have_fwd & (cid >= 0) & (
-            cid < g["hops"].shape[0]
-        )
-        cidc = jnp.clip(cid, 0, g["hops"].shape[0] - 1)
-        hop_row = g["hops"][cidc]
-        my_pos = jnp.argmax(hop_row == me).astype(_I32)  # guard/middle/exit
-        at_exit = my_pos == 2
-        nxt_gid = jnp.where(
-            at_exit, g["srv_gid"][cidc], hop_row[jnp.minimum(my_pos + 1, 2)]
-        )
-        nxt_port = jnp.where(at_exit, g["srv_port"][cidc], g["or_port"])
+        # one lookup serves both roles: relays read the next hop,
+        # servers read the served filesize (a host is only ever one)
+        tc_found, nxt_gid, nxt_port, tc_fsz = _tc_lookup(app, cid)
+        new_circ = is_relay & ~have_fwd & (cid >= 0) & tc_found
+        cidc = jnp.maximum(cid, 0)
 
         # allocate the outbound slot: last free (children fill from 0 up)
         free = hs.net.sockets.proto == PROTO_NONE
@@ -332,8 +409,6 @@ class TorModel:
         # ---------------- server: answer each request cell with filesize
         app = hs.app
         is_server = got & (app.role == ROLE_SERVER)
-        scid = jnp.clip(pkt.src_port - CIRC_PORT_BASE, 0,
-                        g["hops"].shape[0] - 1)
         prev = _sel(app.req_rx, s)
         newr = prev + jnp.where(is_server, dlen, 0)
         n_req = (newr // REQ_BYTES - prev // REQ_BYTES).astype(_I64)
@@ -341,19 +416,17 @@ class TorModel:
             app, req_rx=_put(app.req_rx, s, newr, got)
         )
         hs = dataclasses.replace(hs, app=app)
-        reply = n_req * g["filesize"][scid]
+        reply = n_req * tc_fsz
         hs, em_srv = tcp.send(
-            hs, s, reply, now, mask=is_server & (reply > 0)
+            hs, s, reply, now, mask=is_server & tc_found & (reply > 0)
         )
 
         # ---------------- client: count reply bytes, schedule next fetch
         app = hs.app
-        ccid = g["client_circ"][me]
-        is_client = got & (app.role == ROLE_CLIENT) & (ccid >= 0)
-        ccidc = jnp.maximum(ccid, 0)
+        is_client = got & (app.role == ROLE_CLIENT) & (app.circ_id >= 0)
         rx2 = app.conn_rx + jnp.where(is_client, dlen, 0)
         done_now = jnp.minimum(
-            (rx2 // jnp.maximum(g["filesize"][ccidc], 1)).astype(_I32),
+            (rx2 // jnp.maximum(app.cl_file, 1)).astype(_I32),
             app.streams_started,
         )
         newly = is_client & (done_now > app.streams_done)
@@ -364,10 +437,15 @@ class TorModel:
             t_last_done=jnp.where(newly, now, app.t_last_done),
         )
         hs = dataclasses.replace(hs, app=app)
-        more = newly & (app.streams_done < g["count"][ccidc])
-        pause = g["pause_ns"][
-            ccidc, app.streams_done % jnp.maximum(g["n_pause"][ccidc], 1)
-        ]
+        more = newly & (app.streams_done < app.cl_count)
+        pk_ = app.streams_done % jnp.maximum(app.cl_npause, 1)
+        pause = jnp.sum(
+            jnp.where(
+                jnp.arange(4, dtype=_I32) == pk_, app.cl_pause,
+                jnp.int64(0),
+            ),
+            dtype=_I64,
+        )
         em_next = Emit.single(
             dst=0, dt=pause, kind=self._kind_fetch, mask=more, local=True,
             n_args=N_PKT_ARGS,
