@@ -112,6 +112,8 @@ REAL(ssize_t, msgrcv, (int, void*, size_t, long, int))
 REAL(int, msgsnd, (int, const void*, size_t, int))
 REAL(int, fcntl, (int, int, ...))
 REAL(int, ioctl, (int, unsigned long, ...))
+REAL(int, dup, (int))
+REAL(int, dup2, (int, int))
 
 /* -------------------------------------------------- per-process vfds */
 
@@ -150,6 +152,12 @@ typedef struct Vfd {
     unsigned char wr_shut;
     unsigned char is_urandom; /* /dev/urandom: reads from the per-host
                                  deterministic stream (random.c:15-50) */
+    unsigned char is_real; /* dup2(real_fd, n) shadow: this descriptor
+                              owns a PRIVATE real-fd duplicate (rfd) and
+                              routes read/write/fcntl/ioctl to real
+                              syscalls — the simulator's own fds (its
+                              stdio above all) are never clobbered by a
+                              daemonizing plugin's redirections */
     unsigned int snd_size;
     unsigned int rcv_size;
     int rfd; /* runtime fd; -1 for interposer-local (epoll) */
@@ -178,6 +186,23 @@ typedef struct PerProc {
     Vfd* tab; /* indexed vfd - VFD_BASE */
     int len;
     int next;
+    /* dup(2) support. `refs` is a SPARSE {rfd, count} list holding an
+     * entry only while a runtime object is shared by >1 descriptor
+     * (runtime fds come from a global counter starting at 1e6 —
+     * shim_runtime.cpp kFirstFd — so dense indexing is off the table);
+     * the runtime close runs only when the last dup closes, matching
+     * the reference's per-process descriptor-table counts. low_map[fd]
+     * (fd < VFD_BASE, lazily allocated) lets dup2 target the low
+     * numbers shells redirect to (dup2(sock, 0) and friends): the
+     * entry shadows the simulator's real fd for the PLUGIN's calls
+     * without touching the real fd. */
+    struct RfdRef {
+        int rfd;
+        int cnt; /* descriptors sharing this runtime fd (>= 2) */
+    }* refs;
+    int nrefs;     /* live entries */
+    int cap_refs;  /* allocated entries */
+    int* low_map;  /* [VFD_BASE]; -1 = unmapped, else tab index */
 } PerProc;
 
 static PerProc* g_pp = 0;
@@ -198,13 +223,78 @@ static PerProc* pp(void) {
     return &g_pp[pid];
 }
 
+static struct RfdRef* ref_find(PerProc* p, int rfd) {
+    if (!p || rfd < 0) return 0;
+    for (int i = 0; i < p->nrefs; i++)
+        if (p->refs[i].rfd == rfd) return &p->refs[i];
+    return 0;
+}
+
+/* One more descriptor now shares `rfd` (a dup was made). First share
+ * creates the entry at cnt=2 (original + duplicate). -1 on OOM. */
+static int ref_retain(PerProc* p, int rfd) {
+    if (!p || rfd < 0) return 0; /* interposer-local fds: no runtime obj */
+    struct RfdRef* r = ref_find(p, rfd);
+    if (r) {
+        r->cnt++;
+        return 0;
+    }
+    if (p->nrefs == p->cap_refs) {
+        int n = p->cap_refs ? p->cap_refs * 2 : 8;
+        struct RfdRef* t = realloc(p->refs, n * sizeof(*t));
+        if (!t) return -1;
+        p->refs = t;
+        p->cap_refs = n;
+    }
+    p->refs[p->nrefs].rfd = rfd;
+    p->refs[p->nrefs].cnt = 2;
+    p->nrefs++;
+    return 0;
+}
+
+/* One descriptor for `rfd` closed; returns how many remain (0 = the
+ * caller must close the runtime object). Un-dup'd fds have no entry
+ * and release straight to 0. */
+static int ref_release(int rfd) {
+    PerProc* p = pp();
+    struct RfdRef* r = ref_find(p, rfd);
+    if (!r) return 0;
+    if (--r->cnt <= 1) {
+        /* back to a single owner: drop the entry (cnt==1), or the
+         * last owner just closed (cnt==0 -> report 0) */
+        int remaining = r->cnt;
+        *r = p->refs[--p->nrefs];
+        return remaining;
+    }
+    return r->cnt;
+}
+
 static Vfd* vfd_get(int vfd) {
     PerProc* p = pp();
-    if (!p || vfd < VFD_BASE) return 0;
-    int idx = vfd - VFD_BASE;
+    if (!p || vfd < 0) return 0;
+    int idx;
+    if (vfd < VFD_BASE) {
+        if (!p->low_map || p->low_map[vfd] < 0) return 0;
+        idx = p->low_map[vfd];
+    } else {
+        idx = vfd - VFD_BASE;
+    }
     if (idx >= p->len) return 0;
     Vfd* v = &p->tab[idx];
     return v->used ? v : 0;
+}
+
+/* Grow p->tab to cover slot `idx` (newly covered slots zeroed). */
+static int tab_grow(PerProc* p, int idx) {
+    if (idx < p->len) return 0;
+    int n = p->len ? p->len : 32;
+    while (n <= idx) n *= 2;
+    Vfd* t = realloc(p->tab, n * sizeof(Vfd));
+    if (!t) return -1;
+    memset(t + p->len, 0, (n - p->len) * sizeof(Vfd));
+    p->tab = t;
+    p->len = n;
+    return 0;
 }
 
 static int vfd_alloc(int rfd) {
@@ -215,9 +305,11 @@ static int vfd_alloc(int rfd) {
      * JAX host can hold many device/cache fds): handing such a number
      * out would make read/write/close on the real fd misroute into the
      * simulated stack. Kernel fds allocate lowest-free, so once past
-     * the process's high-water mark this loop exits immediately. */
+     * the process's high-water mark this loop exits immediately. Also
+     * skip slots a targeted dup2 parked above the high-water mark. */
     while (VFD_BASE + idx < VFD_MAX &&
-           get_real_fcntl()(VFD_BASE + idx, F_GETFD, 0) != -1) {
+           ((idx < p->len && p->tab[idx].used) ||
+            get_real_fcntl()(VFD_BASE + idx, F_GETFD, 0) != -1)) {
         idx++;
         p->next = idx;
     }
@@ -227,15 +319,7 @@ static int vfd_alloc(int rfd) {
         }
         if (VFD_BASE + idx >= VFD_MAX) return -1;
     }
-    if (idx >= p->len) {
-        int n = p->len ? p->len : 32;
-        while (n <= idx) n *= 2;
-        Vfd* t = realloc(p->tab, n * sizeof(Vfd));
-        if (!t) return -1;
-        memset(t + p->len, 0, (n - p->len) * sizeof(Vfd));
-        p->tab = t;
-        p->len = n;
-    }
+    if (tab_grow(p, idx) < 0) return -1;
     memset(&p->tab[idx], 0, sizeof(Vfd));
     p->tab[idx].used = 1;
     p->tab[idx].rfd = rfd;
@@ -248,16 +332,47 @@ static int vfd_alloc(int rfd) {
 static void vfd_free(int vfd) {
     Vfd* v = vfd_get(vfd);
     if (!v) return;
+    PerProc* p = pp();
+    if (p && p->low_map) {
+        /* drop every low-fd alias of this slot (closing via either
+         * number releases the descriptor) */
+        int idx = (int)(v - p->tab);
+        for (int i = 0; i < VFD_BASE; i++)
+            if (p->low_map[i] == idx) p->low_map[i] = -1;
+    }
     free(v->watch);
     memset(v, 0, sizeof(*v));
 }
 
+
 static void sig_reset_all(void);
+
+/* Close every is_real slot's private real-fd duplicate for the CURRENT
+ * process — called on the never-returning exit paths (exit(), fatal
+ * signals) so daemonizing plugins cannot leak real kernel fds into the
+ * long-lived simulator process. */
+static void vfd_close_real_dups(void) {
+    PerProc* p = pp();
+    if (!p) return;
+    for (int i = 0; i < p->len; i++) {
+        if (p->tab[i].used && p->tab[i].is_real) {
+            get_real_close()(p->tab[i].rfd);
+            free(p->tab[i].watch);
+            memset(&p->tab[i], 0, sizeof(Vfd));
+        }
+    }
+}
 
 static void vfd_reset_all(void) {
     for (int p = 0; p < g_npp; p++) {
-        for (int i = 0; i < g_pp[p].len; i++) free(g_pp[p].tab[i].watch);
+        for (int i = 0; i < g_pp[p].len; i++) {
+            if (g_pp[p].tab[i].used && g_pp[p].tab[i].is_real)
+                get_real_close()(g_pp[p].tab[i].rfd);
+            free(g_pp[p].tab[i].watch);
+        }
         free(g_pp[p].tab);
+        free(g_pp[p].refs);
+        free(g_pp[p].low_map);
     }
     free(g_pp);
     g_pp = 0;
@@ -315,6 +430,10 @@ int bind(int fd, const struct sockaddr* addr, socklen_t len) {
         errno = EBADF;
         return -1;
     }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
+        return -1;
+    }
     int port = 0;
     if (addr && len >= sizeof(struct sockaddr_in) &&
         addr->sa_family == AF_INET) {
@@ -333,6 +452,10 @@ int listen(int fd, int backlog) {
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     /* port 0 -> the port recorded by bind (ephemeral when unbound) */
@@ -360,6 +483,10 @@ int accept4(int fd, struct sockaddr* addr, socklen_t* addrlen, int flags) {
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     int child_rfd;
@@ -396,6 +523,10 @@ int connect(int fd, const struct sockaddr* addr, socklen_t len) {
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     if (!addr || len < sizeof(struct sockaddr_in) ||
@@ -441,6 +572,10 @@ ssize_t send(int fd, const void* buf, size_t n, int flags) {
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     if (v->is_udp) {
@@ -496,6 +631,10 @@ ssize_t recv(int fd, void* buf, size_t cap, int flags) {
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     if (v->is_udp) {
@@ -559,6 +698,7 @@ ssize_t recvfrom(int fd, void* buf, size_t cap, int flags,
 ssize_t read(int fd, void* buf, size_t cap) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_read()(fd, buf, cap);
+    if (v->is_real) return get_real_read()(v->rfd, buf, cap);
     if (v->is_urandom) {
         rng_fill(buf, cap);
         return (ssize_t)cap;
@@ -591,6 +731,7 @@ ssize_t read(int fd, void* buf, size_t cap) {
 ssize_t write(int fd, const void* buf, size_t n) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_write()(fd, buf, n);
+    if (v->is_real) return get_real_write()(v->rfd, buf, n);
     return send(fd, buf, n, 0);
 }
 
@@ -601,6 +742,7 @@ ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_readv()(fd, iov, iovcnt); /* real files:
         kernel semantics incl. EINVAL/EBADF edges (test_file.c) */
+    if (v->is_real) return get_real_readv()(v->rfd, iov, iovcnt);
     /* one recv's worth of bytes scattered across the iov — readv's
      * single-message semantics over a stream */
     size_t total = 0;
@@ -630,6 +772,7 @@ ssize_t readv(int fd, const struct iovec* iov, int iovcnt) {
 ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_writev()(fd, iov, iovcnt);
+    if (v->is_real) return get_real_writev()(v->rfd, iov, iovcnt);
     ssize_t total = 0;
     for (int i = 0; i < iovcnt; i++) {
         if (iov[i].iov_len == 0) continue;
@@ -658,14 +801,78 @@ static void epoll_forget(int vfd) {
     }
 }
 
+static void epoll_rekey(int oldvfd, int newvfd) {
+    /* re-point every watch on `oldvfd` at `newvfd` (same open
+     * description): Linux keys epoll registrations by description, so
+     * closing the registered NUMBER while a duplicate survives must
+     * not drop events. */
+    PerProc* p = pp();
+    if (!p) return;
+    for (int i = 0; i < p->len; i++) {
+        Vfd* e = &p->tab[i];
+        if (!e->used || !e->is_epoll) continue;
+        for (int j = 0; j < e->n_watch; j++)
+            if (e->watch[j].vfd == oldvfd) e->watch[j].vfd = newvfd;
+    }
+}
+
 int close(int fd) {
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_close()(fd);
     int rfd = v->rfd;
-    int local = v->is_epoll || v->is_urandom;
-    epoll_forget(fd);
+    int is_real = v->is_real;
+    int local = v->is_epoll || v->is_urandom || is_real;
+    /* dup(2): the runtime object closes with its LAST descriptor */
+    int survivors = local ? 0 : ref_release(rfd);
+    PerProc* p = pp();
+    int self = (int)(v - p->tab);
+    int heir_no = -1;
+    if (survivors > 0) {
+        /* a duplicate lives on: migrate epoll registrations to it
+         * (description-keyed on Linux) instead of dropping them. The
+         * heir is addressed by its PLUGIN-VISIBLE number — the low
+         * alias when a dup2-to-low created the slot — so a later
+         * EPOLL_CTL_DEL/MOD through that number still matches. */
+        int heir_idx = -1;
+        for (int i = 0; i < p->len; i++) {
+            if (i != self && p->tab[i].used && !p->tab[i].is_epoll &&
+                !p->tab[i].is_urandom && p->tab[i].rfd == rfd) {
+                heir_idx = i;
+                break;
+            }
+        }
+        if (heir_idx >= 0) {
+            heir_no = VFD_BASE + heir_idx;
+            if (p->low_map) {
+                for (int j = 0; j < VFD_BASE; j++) {
+                    if (p->low_map[j] == heir_idx) {
+                        heir_no = j;
+                        break;
+                    }
+                }
+            }
+        }
+    }
+    /* the closing SLOT may be reachable by several numbers (its high
+     * number plus dup2-to-low aliases): migrate or drop watches under
+     * every one of them, not just the number close() was called with */
+    int aliases[2] = {fd, VFD_BASE + self};
+    for (int a = 0; a < 2; a++) {
+        if (a == 1 && aliases[1] == fd) break;
+        if (heir_no >= 0) epoll_rekey(aliases[a], heir_no);
+        else epoll_forget(aliases[a]);
+    }
+    if (p->low_map) {
+        for (int j = 0; j < VFD_BASE; j++) {
+            if (p->low_map[j] == self && j != fd) {
+                if (heir_no >= 0) epoll_rekey(j, heir_no);
+                else epoll_forget(j);
+            }
+        }
+    }
     vfd_free(fd);
-    if (local) return 0; /* epoll/urandom fds are interposer-local */
+    if (is_real) return get_real_close()(rfd); /* the private real dup */
+    if (local || survivors > 0) return 0;
     return A->sock_close(A->ctx, rfd);
 }
 
@@ -681,7 +888,7 @@ int shutdown(int fd, int how) {
     }
     /* only a connected stream can be shut down (tcp.c shutdown:
      * ENOTCONN pre-handshake; UDP sockets here are never connect()ed) */
-    if (v->is_udp || v->is_epoll || v->is_timer ||
+    if (v->is_udp || v->is_epoll || v->is_timer || v->is_real ||
         A->conn_status(A->ctx, v->rfd) != 1) {
         errno = ENOTCONN;
         return -1;
@@ -704,6 +911,10 @@ int getsockname(int fd, struct sockaddr* addr, socklen_t* addrlen) {
         errno = EBADF;
         return -1;
     }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
+        return -1;
+    }
     fill_inet_addr(addr, addrlen, 0,
                    A->sock_local_port(A->ctx, v->rfd));
     return 0;
@@ -715,6 +926,10 @@ int getpeername(int fd, struct sockaddr* addr, socklen_t* addrlen) {
         errno = EBADF;
         return -1;
     }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
+        return -1;
+    }
     fill_inet_addr(addr, addrlen, 0, 0);
     return 0;
 }
@@ -724,6 +939,10 @@ int setsockopt(int fd, int level, int optname, const void* optval,
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     if (level == SOL_SOCKET && optval && optlen >= sizeof(int)) {
@@ -752,6 +971,10 @@ int getsockopt(int fd, int level, int optname, void* optval,
     Vfd* v = vfd_get(fd);
     if (!v) {
         errno = EBADF;
+        return -1;
+    }
+    if (v->is_real) {
+        errno = ENOTSOCK; /* a dup2'd real file is not a socket */
         return -1;
     }
     if (level == SOL_SOCKET && optname == SO_ERROR && optval && optlen &&
@@ -788,6 +1011,7 @@ int ioctl(int fd, unsigned long request, ...) {
     va_end(ap);
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_ioctl()(fd, request, argp); /* tty/file fds */
+    if (v->is_real) return get_real_ioctl()(v->rfd, request, argp);
     /* FIONREAD == SIOCINQ; TIOCOUTQ == SIOCOUTQ (sockbuf test's queue
      * probes — the reference emulates both from its buffer lengths) */
     if (request == FIONREAD) {
@@ -813,12 +1037,223 @@ int fcntl(int fd, int cmd, ...) {
     va_end(ap);
     Vfd* v = vfd_get(fd);
     if (!v) return get_real_fcntl()(fd, cmd, arg);
+    if (v->is_real && cmd != F_DUPFD && cmd != F_DUPFD_CLOEXEC)
+        return get_real_fcntl()(v->rfd, cmd, arg);
     if (cmd == F_GETFL) return v->nonblock ? O_NONBLOCK : 0;
     if (cmd == F_SETFL) {
         v->nonblock = (arg & O_NONBLOCK) ? 1 : 0;
         return 0;
     }
+    if (cmd == F_DUPFD || cmd == F_DUPFD_CLOEXEC) {
+        /* the ">= arg" placement hint is approximated: duplicates live
+         * in the VFD_BASE.. range, above any plausible hint */
+        return dup(fd);
+    }
     return 0;
+}
+
+/* ------------------------------------------------------------ dup(2) */
+
+/* Deep-copy `v`'s descriptor state into `out` (one struct assignment
+ * plus the epoll interest list). Flag state (nonblock, shutdown
+ * halves, buffer sizes) is copied at dup time — Linux keeps status
+ * flags on the shared open description, so post-dup F_SETFL
+ * divergence across the pair is a documented deviation; likewise an
+ * epoll duplicate's interest list stops tracking CTL calls on the
+ * original. -1 on OOM with `out` untouched. */
+static int vfd_copy(const Vfd* v, Vfd* out) {
+    EpollWatch* w = 0;
+    if (v->watch && v->n_watch > 0) {
+        w = malloc(v->cap_watch * sizeof(EpollWatch));
+        if (!w) return -1;
+        memcpy(w, v->watch, v->n_watch * sizeof(EpollWatch));
+    }
+    *out = *v;
+    out->watch = w;
+    if (!w) {
+        /* an emptied interest list must not leave a stale cap_watch:
+         * epoll_ctl ADD skips allocation when n_watch != cap_watch */
+        out->n_watch = 0;
+        out->cap_watch = 0;
+    }
+    return 0;
+}
+
+int dup(int fd) {
+    Vfd* v = vfd_get(fd);
+    if (!v) return get_real_dup()(fd);
+    PerProc* p = pp();
+    int nv = vfd_alloc(v->rfd);
+    if (nv < 0) {
+        errno = EMFILE;
+        return -1;
+    }
+    v = vfd_get(fd); /* vfd_alloc may have moved the table */
+    Vfd copy;
+    if (vfd_copy(v, &copy) < 0) {
+        vfd_free(nv);
+        errno = ENOMEM;
+        return -1;
+    }
+    if (copy.is_real) {
+        /* real-shadow duplicates each own a private real dup (no
+         * runtime object to refcount) */
+        int c2 = get_real_dup()(copy.rfd);
+        if (c2 < 0) {
+            free(copy.watch);
+            vfd_free(nv);
+            return -1; /* errno from dup(2) */
+        }
+        copy.rfd = c2;
+    } else if (ref_retain(p, v->rfd) < 0) {
+        free(copy.watch);
+        vfd_free(nv);
+        errno = ENOMEM;
+        return -1;
+    }
+    *vfd_get(nv) = copy;
+    return nv;
+}
+
+/* Validate and prepare a dup2 TARGET number: range check, EBUSY probe
+ * (a high number occupied by a live simulator real fd), table growth
+ * for a high slot, lazy low_map allocation for a low one. All fallible
+ * work happens here, BEFORE the caller disturbs newfd (POSIX: newfd is
+ * left open when dup2 fails). Sets *high; -1 with errno on failure. */
+static int prepare_newfd_target(PerProc* p, int newfd, int* high) {
+    if (newfd < 0 || newfd >= VFD_MAX) {
+        errno = EBADF;
+        return -1;
+    }
+    *high = newfd >= VFD_BASE;
+    if (*high) {
+        if (!vfd_get(newfd) &&
+            get_real_fcntl()(newfd, F_GETFD, 0) != -1) {
+            /* the number is a live REAL fd of the simulator process;
+             * stealing it would misroute the runtime's own IO */
+            errno = EBUSY;
+            return -1;
+        }
+        if (tab_grow(p, newfd - VFD_BASE) < 0) {
+            errno = ENOMEM;
+            return -1;
+        }
+    } else if (!p->low_map) {
+        p->low_map = malloc(VFD_BASE * sizeof(int));
+        if (!p->low_map) {
+            errno = ENOMEM;
+            return -1;
+        }
+        for (int i = 0; i < VFD_BASE; i++) p->low_map[i] = -1;
+    }
+    return 0;
+}
+
+int dup2(int oldfd, int newfd) {
+    Vfd* v = vfd_get(oldfd);
+    if (!v) {
+        /* real oldfd (an open()ed file, /dev/null, ...): NEVER run the
+         * real dup2 — a daemonizing plugin's dup2(devnull, 1) would
+         * clobber the SIMULATOR's stdout process-wide. Instead park a
+         * private real dup behind an is_real shadow slot, so the
+         * plugin's view of `newfd` changes while the simulator's real
+         * fd table stays untouched. Fallible steps precede any
+         * teardown of newfd (POSIX: untouched on failure). */
+        PerProc* p0 = pp();
+        if (!p0) return get_real_dup2()(oldfd, newfd); /* no process
+            context: not a plugin call */
+        if (get_real_fcntl()(oldfd, F_GETFD, 0) == -1) {
+            errno = EBADF;
+            return -1;
+        }
+        if (oldfd == newfd) return newfd;
+        int high0;
+        if (prepare_newfd_target(p0, newfd, &high0) < 0) return -1;
+        int copy = get_real_dup()(oldfd);
+        if (copy < 0) return -1;
+        int slot;
+        if (high0) {
+            if (vfd_get(newfd)) close(newfd);
+            slot = newfd - VFD_BASE;
+        } else {
+            int nv2 = vfd_alloc(-1);
+            if (nv2 < 0) {
+                get_real_close()(copy);
+                errno = EMFILE;
+                return -1;
+            }
+            if (vfd_get(newfd)) close(newfd);
+            slot = nv2 - VFD_BASE;
+            p0->low_map[newfd] = slot;
+        }
+        memset(&p0->tab[slot], 0, sizeof(Vfd));
+        p0->tab[slot].used = 1;
+        p0->tab[slot].is_real = 1;
+        p0->tab[slot].rfd = copy;
+        return newfd;
+    }
+    if (newfd == oldfd) return newfd;
+    PerProc* p = pp();
+    /* the two numbers may already alias ONE descriptor slot (a prior
+     * low-fd dup2 plus its hidden high twin): nothing to do, and
+     * closing newfd here would tear down oldfd too */
+    if (vfd_get(newfd) == v) return newfd;
+    int high;
+    if (prepare_newfd_target(p, newfd, &high) < 0) return -1;
+    int nv_low = -1;
+    if (!high) {
+        nv_low = vfd_alloc(v->rfd);
+        if (nv_low < 0) {
+            errno = EMFILE;
+            return -1;
+        }
+    }
+    v = vfd_get(oldfd); /* the table may have moved/grown above */
+    Vfd snap; /* survives a close(newfd) that frees other slots */
+    if (vfd_copy(v, &snap) < 0) {
+        if (nv_low >= 0) vfd_free(nv_low);
+        errno = ENOMEM;
+        return -1;
+    }
+    if (snap.is_real) {
+        int c2 = get_real_dup()(snap.rfd); /* private real dup */
+        if (c2 < 0) {
+            free(snap.watch);
+            if (nv_low >= 0) vfd_free(nv_low);
+            return -1; /* errno from dup(2) */
+        }
+        snap.rfd = c2;
+    } else if (ref_retain(p, v->rfd) < 0) {
+        free(snap.watch);
+        if (nv_low >= 0) vfd_free(nv_low);
+        errno = ENOMEM;
+        return -1;
+    }
+    if (vfd_get(newfd)) close(newfd);
+    if (high) {
+        p->tab[newfd - VFD_BASE] = snap;
+    } else {
+        /* low target (dup2(sock, 0) shell-style redirection): map the
+         * number to a fresh slot; the simulator's real fd `newfd` is
+         * shadowed for plugin calls, never touched */
+        p->tab[nv_low - VFD_BASE] = snap;
+        p->low_map[newfd] = nv_low - VFD_BASE;
+    }
+    return newfd;
+}
+
+int dup3(int oldfd, int newfd, int flags) {
+    if (newfd == oldfd) {
+        errno = EINVAL; /* dup3 differs from dup2 here */
+        return -1;
+    }
+    if (flags & ~O_CLOEXEC) {
+        errno = EINVAL; /* only O_CLOEXEC is a valid dup3 flag —
+            validated BEFORE newfd is disturbed, both branches */
+        return -1;
+    }
+    /* O_CLOEXEC itself is a no-op: no exec inside the simulation */
+    return dup2(oldfd, newfd);
 }
 
 /* --------------------------------------------------------------- pipes */
@@ -1109,8 +1544,9 @@ int poll(struct pollfd* fds, nfds_t nfds, int timeout_ms) {
         fds[i].revents = 0;
         rfds[i] = -1;
         want[i] = 0;
-        if (!v) {
-            /* REAL fd: a live regular file or tty is always ready for
+        if (!v || v->is_real) {
+            /* REAL fd (direct, or a dup2 shadow owning a private real
+             * dup): a live regular file or tty is always ready for
              * what it asked (poll(2) file semantics — the reference's
              * poll test polls a creat() fd and expects readiness).
              * Other real kinds (a pipe inherited from the harness)
@@ -1118,7 +1554,7 @@ int poll(struct pollfd* fds, nfds_t nfds, int timeout_ms) {
              * whole simulator in real time. A dead fd reports POLLNVAL
              * per POSIX, never an error. */
             struct stat rst;
-            if (fstat(fds[i].fd, &rst) == 0) {
+            if (fstat(v ? v->rfd : fds[i].fd, &rst) == 0) {
                 if (S_ISREG(rst.st_mode) || S_ISCHR(rst.st_mode)) {
                     fds[i].revents =
                         fds[i].events & (POLLIN | POLLOUT);
@@ -1175,7 +1611,9 @@ int select(int nfds, fd_set* readfds, fd_set* writefds, fd_set* exceptfds,
     }
     int vlist[FD_SETSIZE], rfds[FD_SETSIZE];
     unsigned char want[FD_SETSIZE], ready[FD_SETSIZE];
-    int n = 0;
+    int real_fd[FD_SETSIZE];
+    unsigned char real_want[FD_SETSIZE];
+    int n = 0, n_real = 0;
     for (int fd = 0; fd < nfds; fd++) {
         unsigned char w = 0;
         if (readfds && FD_ISSET(fd, readfds)) w |= 1;
@@ -1187,6 +1625,18 @@ int select(int nfds, fd_set* readfds, fd_set* writefds, fd_set* exceptfds,
             errno = EBADF;
             return -1;
         }
+        if (v->is_real) {
+            /* dup2 shadow of a real file: always ready for what it
+             * asked (select(2) file semantics, as in poll above) */
+            struct stat rst;
+            if (fstat(v->rfd, &rst) == 0 &&
+                (S_ISREG(rst.st_mode) || S_ISCHR(rst.st_mode))) {
+                real_fd[n_real] = fd;
+                real_want[n_real] = w;
+                n_real++;
+            }
+            continue;
+        }
         vlist[n] = fd;
         rfds[n] = v->rfd;
         want[n] = w;
@@ -1196,16 +1646,24 @@ int select(int nfds, fd_set* readfds, fd_set* writefds, fd_set* exceptfds,
     if (timeout)
         tns = (int64_t)timeout->tv_sec * 1000000000LL +
               (int64_t)timeout->tv_usec * 1000LL;
-    if (n == 0) {
+    if (n == 0 && n_real == 0) {
         if (tns > 0) A->sleep_ns(A->ctx, tns); /* pure sleep */
         return 0;
     }
-    int got = A->poll_many(A->ctx, rfds, want, n, tns, ready);
+    /* an already-ready real fd turns the virtual wait into a probe */
+    if (n_real > 0) tns = 0;
+    int got = 0;
+    if (n > 0) got = A->poll_many(A->ctx, rfds, want, n, tns, ready);
     if (readfds) FD_ZERO(readfds);
     if (writefds) FD_ZERO(writefds);
     if (exceptfds) FD_ZERO(exceptfds);
-    if (got <= 0) return 0;
     int count = 0;
+    for (int i = 0; i < n_real; i++) {
+        if ((real_want[i] & 1) && readfds) FD_SET(real_fd[i], readfds);
+        if ((real_want[i] & 2) && writefds) FD_SET(real_fd[i], writefds);
+        count++;
+    }
+    if (got <= 0) return count;
     for (int i = 0; i < n; i++) {
         if (!ready[i]) continue;
         int hit = 0;
@@ -1266,11 +1724,16 @@ int epoll_ctl(int epfd, int op, int fd, struct epoll_event* event) {
         errno = EFAULT;
         return -1;
     }
-    if (!vfd_get(fd)) {
+    Vfd* tv = vfd_get(fd);
+    if (!tv) {
         /* a live REAL fd here is a regular file: epoll rejects those
          * with EPERM (the reference's epoll does the same; its test
          * asserts the errno, test_epoll.c _test_creat) */
         errno = get_real_fcntl()(fd, F_GETFD, 0) != -1 ? EPERM : EBADF;
+        return -1;
+    }
+    if (tv->is_real) {
+        errno = EPERM; /* dup2 shadow of a real file: same rule */
         return -1;
     }
     for (int i = 0; i < e->n_watch; i++) {
@@ -1717,7 +2180,10 @@ static void sig_raise_self(int sig) {
         return;
     }
     if (s->ignored[sig]) return;
-    if (A) A->proc_exit(A->ctx, 128 + sig); /* never returns */
+    if (A) {
+        vfd_close_real_dups();
+        A->proc_exit(A->ctx, 128 + sig); /* never returns */
+    }
 }
 
 static void sig_trampoline(int sn) {
@@ -1982,6 +2448,7 @@ int kill(pid_t pid, int sig) {
         if (sig == SIGCHLD || sig == SIGURG || sig == SIGWINCH ||
             sig == SIGCONT)
             return 0;
+        vfd_close_real_dups();
         A->proc_exit(A->ctx, 128 + sig); /* never returns */
         return 0;
     }
@@ -1992,6 +2459,7 @@ int kill(pid_t pid, int sig) {
 void exit(int code) {
     if (A) {
         fflush(0);
+        vfd_close_real_dups();
         A->proc_exit(A->ctx, code); /* never returns */
     }
     _Exit(code);

@@ -259,6 +259,108 @@ def test_socketpair_full_duplex(capfd):
     os.remove(src)
 
 
+def test_dup_family(capfd):
+    """dup/dup2/dup3/F_DUPFD over the simulated stack: duplicates share
+    the runtime socket (one write, either fd reads), the object survives
+    until the LAST duplicate closes, dup2 redirects onto low fd numbers
+    shell-style (process.c descriptor-table dup semantics in the
+    reference; preload_defs.h dup rows)."""
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "native/plugins/_t_dup.c")
+    with open(src, "w") as f:
+        f.write(textwrap.dedent("""\
+        #include <fcntl.h>
+        #include <stdio.h>
+        #include <string.h>
+        #include <sys/epoll.h>
+        #include <sys/socket.h>
+        #include <unistd.h>
+
+        int main(void) {
+            int sv[2];
+            if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) return 10;
+            char buf[16] = {0};
+            int d = dup(sv[0]);
+            if (d < 0) return 11;
+            if (write(d, "viaD", 5) != 5) return 12;   /* dup writes */
+            if (read(sv[1], buf, sizeof buf) != 5) return 13;
+            if (strcmp(buf, "viaD") != 0) return 14;
+            close(sv[0]);                     /* original closes... */
+            if (write(d, "live", 5) != 5) return 15; /* ...dup lives */
+            if (read(sv[1], buf, sizeof buf) != 5) return 16;
+            if (strcmp(buf, "live") != 0) return 17;
+            if (dup2(d, 5) != 5) return 18;   /* low-fd redirection */
+            if (write(5, "lowF", 5) != 5) return 19;
+            if (read(sv[1], buf, sizeof buf) != 5) return 20;
+            if (strcmp(buf, "lowF") != 0) return 21;
+            int t;             /* probe: the host process may hold any
+                                  real fd number open (EBUSY there) */
+            for (t = 700; t < 900; t++) if (dup2(d, t) == t) break;
+            if (t >= 900) return 27;           /* targeted high fd */
+            int sv2[2];               /* allocator must skip slot t */
+            if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv2) != 0) return 28;
+            if (sv2[0] == t || sv2[1] == t) return 29;
+            if (write(t, "high", 5) != 5) return 30;
+            if (read(sv[1], buf, sizeof buf) != 5) return 31;
+            if (strcmp(buf, "high") != 0) return 32;
+            /* an epoll watch survives closing the REGISTERED number
+               while a duplicate lives (description-keyed on Linux) */
+            int ep = epoll_create1(0);
+            struct epoll_event e = {EPOLLIN, {.u32 = 77}};
+            if (epoll_ctl(ep, EPOLL_CTL_ADD, t, &e) != 0) return 33;
+            close(t);                     /* dup d still holds it */
+            if (write(sv[1], "ping", 5) != 5) return 34;
+            struct epoll_event got;
+            if (epoll_wait(ep, &got, 1, 1000) != 1) return 35;
+            if (got.data.u32 != 77) return 36;
+            if (read(d, buf, sizeof buf) != 5) return 37;
+            close(ep);
+            close(sv2[0]);
+            close(sv2[1]);
+            if (dup3(d, d, 0) != -1) return 22;  /* EINVAL, not dup2 */
+            if (dup3(d, 5, O_NONBLOCK) != -1) return 38; /* bad flag */
+            int f = fcntl(d, F_DUPFD, 0);
+            if (f < 0) return 23;
+            close(d);
+            close(5);
+            if (write(f, "last", 5) != 5) return 24; /* last ref live */
+            if (read(sv[1], buf, sizeof buf) != 5) return 25;
+            close(f);                      /* LAST duplicate: EOF now */
+            if (read(sv[1], buf, sizeof buf) != 0) return 26;
+            /* daemon-style stdout redirection must shadow the PLUGIN's
+               fd 1 without clobbering the simulator's real stdout (the
+               harness still captures DUP_OK below) */
+            int nul = open("/dev/null", O_WRONLY);
+            if (nul < 0) return 40;
+            if (dup2(nul, 1) != 1) return 41;
+            if (write(1, "swallowed\\n", 10) != 10) return 42;
+            close(1);       /* drop the shadow before reporting */
+            close(nul);
+            printf("DUP_OK\\n");
+            return 0;
+        }
+        """))
+    plug = compile_posix_plugin(src, name="_t_dup")
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="30">
+      <topology><![CDATA[{TOPO}]]></topology>
+      <plugin id="_t_dup" path="{plug}"/>
+      <host id="h0">
+        <process plugin="_t_dup" starttime="1" arguments=""/>
+      </host>
+    </shadow>"""))
+    tier = ProcessTier(cfg, seed=7)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2000:])
+    assert "DUP_OK" in out
+    tier.close()
+    os.remove(src)
+
+
 def test_reference_test_shutdown_unmodified(capfd):
     """src/test/shutdown/test_shutdown.c (+ test_common.c): real
     shutdown(2) half-close on the TCP machinery — ENOTCONN before
