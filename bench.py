@@ -183,13 +183,25 @@ def _build_on_cpu(cfg, **kw):
     """build_simulation with EAGER ops pinned to the host CPU, then one
     transfer of the finished state to the accelerator. Building on the
     axon device costs one tunnel round trip per eager op — measured 18
-    minutes for the 10k-host Tor shape vs 48 s this way."""
+    minutes for the 10k-host Tor shape vs 48 s this way.
+
+    The CPU-backend compiles from the build phase land in a SEPARATE
+    cache dir: mixing CPU AOT entries into the TPU cache has produced
+    cross-machine feature-mismatch loads that execute silently wrong
+    (tests/conftest.py documents the observed case)."""
     import jax
 
     from shadow_tpu.sim import build_simulation
 
-    with jax.default_device(jax.devices("cpu")[0]):
-        sim = build_simulation(cfg, **kw)
+    tpu_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    cpu_dir = os.path.join(_REPO, ".jax_cache_cpu")
+    os.makedirs(cpu_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cpu_dir)
+    try:
+        with jax.default_device(jax.devices("cpu")[0]):
+            sim = build_simulation(cfg, **kw)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", tpu_dir)
     sim.state0 = jax.device_put(sim.state0, jax.devices()[0])
     return sim
 
@@ -216,11 +228,14 @@ def tor_worker():
     tier_idx = int(os.environ.get("BENCH_TOR_TIER", 0)) % len(TOR_TIERS)
     relays, clients, servers = TOR_TIERS[tier_idx]
     # measured horizon shrinks with tier size so every tier's timed run
-    # fits a per-round budget; sim-s/wall-s is horizon-independent.
-    # Tier 3 must reach past t=3: clients start staggered at 3 + i%20 s,
-    # so a shorter horizon measures an empty network (r05 first attempt:
-    # 0 events over 3 sim-s).
-    stop_s = (20, 10, 5, 6)[tier_idx]
+    # fits a per-round budget. Every tier reaches past t=8: clients
+    # start staggered at 3 + i%5 s (examples.py), so the window covers
+    # the steady state torperf-style baselines report rather than the
+    # rampup idle (r05 first attempts measured 0-20% of clients live).
+    # BENCH_TOR_STOP_S, not BENCH_STOP_S: main() exports the latter for
+    # the PHOLD workers, which would silently preempt the tier tuple
+    stop_s = (20, 10, 10, 10)[tier_idx]
+    stop_s = int(os.environ.get("BENCH_TOR_STOP_S", stop_s))
     _stamp(f"tor tier {relays}/{clients}/{servers} cpu={with_cpu}: building")
     cfg = parse_config(tor_example(
         n_relays_per_class=relays, n_clients=clients,

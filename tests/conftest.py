@@ -25,8 +25,13 @@ jax.config.update("jax_platforms", "cpu")
 # (measured: a cold full-suite run spends >80% of its wall time in
 # compiles). Cache entries are keyed on HLO hash, so identical
 # (shape, handler-table) engines across tests and across runs share one
-# compile. Same mechanism bench.py uses on the TPU backend.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+# compile. Same mechanism bench.py uses on the TPU backend — but in a
+# SEPARATE directory: sharing one cache dir between the axon/TPU bench
+# and the CPU suite has produced cross-machine CPU AOT loads whose
+# feature mismatch the loader itself flags as able to cause "execution
+# errors" (observed once as silently wrong simulation results —
+# "missing: 28" from a bitcoin run whose rerun gave the correct 0).
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache_cpu")
 os.makedirs(_cache_dir, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
