@@ -244,7 +244,12 @@ def tor_worker():
     ))
     runahead_ms = float(os.environ.get("BENCH_RUNAHEAD_MS", 0))
     sim = _build_on_cpu(
-        cfg, seed=1, n_sockets=48, capacity=768,
+        cfg, seed=1,
+        # 32 sockets cover the worst role (a server carries ~23 conns:
+        # clients/servers + listener); the socket tables are the
+        # handler pass's dominant state traffic, so width is wall time
+        n_sockets=int(os.environ.get("BENCH_TOR_NSOCK", 32)),
+        capacity=768,
         runahead_ns=(
             int(runahead_ms * MILLISECOND) if runahead_ms > 0 else None
         ),

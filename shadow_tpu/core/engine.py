@@ -192,6 +192,25 @@ class EngineConfig:
     drain_batch: int = 32  # B: frontier events extracted per host per sweep
     route_bucket: int = 0  # per-peer all_to_all bucket slots (0 = auto)
     stage_width: int = 0  # staging slots per host (0 = auto: B + 4K)
+    # Burst delivery: fold contiguous same-flow packet arrivals staged in
+    # one sweep into a single multi-segment event — the chained drain's
+    # sequential depth is the busiest host's event count, and TCP data
+    # bursts are most of it. None disables. The tuple is a static
+    # descriptor supplied by the stack layer:
+    #   (kind, seq_arg, len_arg, sport_arg, dport_arg, meta_arg,
+    #    proto, flags_excl_mask, mss, ack_arg, wnd_arg, aux_arg)
+    # Eligible events (matching kind/proto, none of the excluded flags,
+    # 0 < len <= mss) that form a strictly seq-contiguous run of one
+    # (src, sport, dport) flow collapse into the run head: its length
+    # word becomes total_bytes | (n_segments << 24), its time the run's
+    # earliest. PATH loss is exact (reliability was rolled per packet
+    # at send time, before folding); receiver-side drop-tail and CoDel
+    # verdicts coarsen to one per burst, and absorbed segments' timing
+    # coarsens by at most the window width — the same tradeoff class as
+    # Stack(fuse_rx=True). Dup-ACK counting is burst-exact: an ACK
+    # answering a fold carries its segment count, so the peer's fast
+    # retransmit fires at the same byte position as unfolded.
+    burst: tuple | None = None
 
     def __post_init__(self):
         # a window of width 0 can never drain an event: the compiled outer
@@ -205,6 +224,15 @@ class EngineConfig:
         if self.route_bucket < 0:
             raise ValueError(
                 f"route_bucket must be >= 0, got {self.route_bucket}"
+            )
+        if self.burst is not None and self.eff_stage_width > 127:
+            # the fold packs its run count into bits 24..30 of the
+            # length word; a wider staging buffer could form runs that
+            # silently overflow into the sign bit — refuse loudly
+            raise ValueError(
+                f"burst folding requires stage_width <= 127 (got "
+                f"{self.eff_stage_width}); shrink drain_batch/stage_width "
+                "or disable burst"
             )
         if self.stage_width and self.stage_width < self.eff_drain_batch + self.max_emit:
             # staging must hold a full frontier dump plus one handler's
@@ -678,6 +706,98 @@ class Engine:
         )
 
     # -- staging-buffer helpers (chained drain) ------------------------------
+    def _burst_fold(self, stage: Events) -> Events:
+        """Collapse contiguous same-flow arrival runs in [H, SW] staging.
+
+        Sort each host's staged events by (flow key, tcp seq); a run of
+        eligible events whose seqs chain by +1 (every segment before the
+        last full-MSS) folds into its head: length word = total |
+        (count << 24), time = run min. Absorbed slots clear. All work is
+        one lax.sort plus [H, SW, SW] masked reductions — no scatter.
+        Slot order afterwards is arbitrary, which staging permits
+        (_stage_min selects by content, _stage_append by free rank).
+        """
+        (kind, seq_a, len_a, sport_a, dport_a, meta_a, proto, flags_x,
+         mss, ack_a, wnd_a, aux_a) = self.cfg.burst
+        t = stage.time
+        h, sw = t.shape
+        meta = stage.args[:, :, meta_a]
+        ln = stage.args[:, :, len_a]
+        elig = (
+            (t != TIME_INVALID)
+            & (stage.kind == kind)
+            & ((meta & 0x3) == proto)
+            & ((meta & flags_x) == 0)
+            & (ln > 0) & (ln <= mss)
+        )
+        i64max = jnp.iinfo(jnp.int64).max
+        slot = jnp.arange(sw, dtype=jnp.int64)[None, :]
+        flow = (
+            (stage.src.astype(jnp.int64) << 32)
+            | (stage.args[:, :, sport_a].astype(jnp.int64) << 16)
+            | stage.args[:, :, dport_a].astype(jnp.int64)
+        )
+        k1 = jnp.where(elig, flow, i64max - sw + slot)  # inelig: stable tail
+        k2 = jnp.where(elig, stage.args[:, :, seq_a].astype(jnp.int64), 0)
+        cols = jax.lax.sort(
+            (k1, k2, t, stage.dst, stage.src, stage.seq, stage.kind,
+             *[stage.args[:, :, i] for i in range(stage.args.shape[2])]),
+            dimension=1, num_keys=2,
+        )
+        k1, k2, t2, dst2, src2, seq2, kind2, *acols = cols
+        args2 = jnp.stack(acols, axis=-1)
+        ln2 = args2[:, :, len_a]
+        elig2 = k1 < (i64max - sw)  # eligibility survives the sort via k1
+        prev = lambda a, fill: jnp.concatenate(
+            [jnp.full_like(a[:, :1], fill), a[:, :-1]], axis=1
+        )
+        contig = (
+            elig2
+            & prev(elig2, False)
+            & (k1 == prev(k1, -1))
+            & (k2 == prev(k2, i64max) + 1)
+            & (prev(ln2, 0) == mss)  # only a run's LAST segment may be short
+        )
+        start = elig2 & ~contig
+        run = jnp.cumsum(start.astype(jnp.int32), axis=1)  # run id per slot
+        same = (
+            (run[:, :, None] == run[:, None, :])
+            & elig2[:, :, None] & elig2[:, None, :]
+        )  # [H, SW, SW]
+        count = jnp.sum(same, axis=2, dtype=jnp.int32)
+        total = jnp.sum(
+            jnp.where(same, ln2[:, None, :], 0), axis=2, dtype=ln2.dtype
+        )
+        tmin = jnp.min(
+            jnp.where(same, t2[:, None, :], i64max), axis=2
+        )
+        # count is uniform across a run's members, so membership in a
+        # folded (>1 segment) run is a direct test
+        folded_head = start & (count > 1)
+        absorbed = elig2 & contig & (count > 1)
+        args2 = args2.at[:, :, len_a].set(
+            jnp.where(folded_head, total | (count << 24), ln2)
+        )
+        # the head keeps the run's FRESHEST piggybacked control state:
+        # later segments carry strictly newer cumulative acks, window
+        # advertisements, and timestamps — dropping them would lag the
+        # peer's snd_una/rwnd/RTT by up to a burst
+        i32min = jnp.iinfo(jnp.int32).min
+        for col in (ack_a, wnd_a, aux_a):
+            v = args2[:, :, col]
+            vmax = jnp.max(
+                jnp.where(same, v[:, None, :], i32min), axis=2
+            )
+            args2 = args2.at[:, :, col].set(
+                jnp.where(folded_head, vmax, v)
+            )
+        return Events(
+            time=jnp.where(
+                absorbed, TIME_INVALID, jnp.where(folded_head, tmin, t2)
+            ),
+            dst=dst2, src=src2, seq=seq2, kind=kind2, args=args2,
+        )
+
     @staticmethod
     def _stage_min(stage: Events):
         """Per host, the minimum-(time, src, seq) staged event.
@@ -810,6 +930,11 @@ class Engine:
             q = dataclasses.replace(
                 q, time=jnp.where(cleared, TIME_INVALID, q.time)
             )
+            if cfg.burst is not None:
+                # the dump is each host's earliest-b prefix, so every
+                # staged event precedes the queue head: folding inside
+                # it can never violate the head guard below
+                stage = self._burst_fold(stage)
 
             # queue-head guard: the first UN-dumped event's key, per host
             # (rows keep a sorted tail after the prefix clear, so it sits
@@ -889,6 +1014,19 @@ class Engine:
                 )
                 if self._cpu_enabled:
                     ev_cost = _kind_cost(cpu_cost, ev.kind)
+                    if self.cfg.burst is not None:
+                        # a folded arrival stands for nseg segments: the
+                        # virtual CPU pays per segment, not per event.
+                        # Zero-payload count carriers (dup ACKs) are one
+                        # packet; their count is protocol bookkeeping.
+                        bkind, _sq, blen = self.cfg.burst[:3]
+                        lw = ev.args[:, blen]
+                        nseg = jnp.where(
+                            (lw & 0xFFFFFF) > 0, jnp.maximum(lw >> 24, 1), 1
+                        )
+                        ev_cost = ev_cost * jnp.where(
+                            ev.kind == bkind, nseg.astype(ev_cost.dtype), 1
+                        )
                     cpu_free = jnp.where(
                         active & (ev_cost > 0), eff_t + ev_cost,
                         cpu_free,

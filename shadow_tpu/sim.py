@@ -409,6 +409,7 @@ def build_simulation(
     locality: bool = False,
     runahead_ns: int | None = None,
     fuse_rx: bool = True,
+    burst_rx: bool = True,
     shape_bucket: bool = True,
 ) -> Simulation:
     """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
@@ -727,10 +728,30 @@ def build_simulation(
         axis_name = hosts_axes(mesh)
     else:
         n_shards, per_shard, axis_name = 1, n_hosts, None
+    # burst delivery (engine._burst_fold): contiguous same-flow TCP
+    # arrivals staged in one sweep collapse into multi-segment events.
+    # The chained drain's wall time is (busiest host's sequential event
+    # count) x (full handler-pass cost), and steady-state TCP data
+    # bursts dominate that count. Requires fuse_rx (the delivery runs
+    # inside the arrival) and the TCP stack. Timing of absorbed
+    # segments coarsens by at most one window; loss fidelity is exact
+    # (reliability rolls happened at send time).
+    burst = None
+    if burst_rx and fuse_rx and tcp is not None:
+        from shadow_tpu.transport.stack import (
+            A_ACK, A_AUX, A_DPORT, A_LEN, A_META, A_SEQ, A_SPORT, A_WND,
+            F_FIN, F_RST, F_SYN, KIND_PKT_ARRIVE,
+        )
+        from shadow_tpu.host.sockets import PROTO_TCP
+        from shadow_tpu.transport.tcp import MSS
+
+        burst = (KIND_PKT_ARRIVE, A_SEQ, A_LEN, A_SPORT, A_DPORT, A_META,
+                 int(PROTO_TCP), int(F_SYN | F_FIN | F_RST), int(MSS),
+                 A_ACK, A_WND, A_AUX)
     ecfg = EngineConfig(
         n_hosts=per_shard, capacity=capacity, lookahead=lookahead,
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
-        axis_name=axis_name, n_shards=n_shards,
+        axis_name=axis_name, n_shards=n_shards, burst=burst,
     )
     network = topo.build_network(host_vertex)
     # per-KIND CPU charges: a model may declare cycle costs for specific

@@ -81,6 +81,10 @@ class Pkt:
     wnd: jax.Array
     aux: jax.Array
     sack: jax.Array  # u64 bitmap: bit i = segment ack+i held by receiver
+    # burst delivery (engine._burst_fold): this packet stands for `nseg`
+    # contiguous same-flow segments totalling `length` bytes; 1 for every
+    # packet the fold never touched. Rides the A_LEN word's bits 24..30.
+    nseg: jax.Array
 
     @staticmethod
     def decode(ev: Events) -> "Pkt":
@@ -94,9 +98,10 @@ class Pkt:
             dst_port=a[A_DPORT],
             seq=a[A_SEQ],
             ack=a[A_ACK],
-            length=a[A_LEN],
+            length=a[A_LEN] & 0xFFFFFF,
             wnd=a[A_WND],
             aux=a[A_AUX],
+            nseg=jnp.maximum(a[A_LEN] >> 24, 1),
             sack=(
                 a[A_SACK0].astype(jnp.uint32).astype(jnp.uint64)
                 | (a[A_SACK1].astype(jnp.uint32).astype(jnp.uint64) << 32)
@@ -300,7 +305,13 @@ class Stack:
             # in both directions (network_interface.c:192-226)
             proto = ev.args[A_META] & 0x3
             header = jnp.where(proto == PROTO_TCP, HEADER_TCP, HEADER_UDP)
-            wire = ev.args[A_LEN] + header
+            # a burst-folded arrival stands for nseg wire packets: its
+            # payload is the run's total and each segment pays a header.
+            # A zero-payload packet with a count (a dup ACK answering a
+            # fold) is ONE wire packet — the count is ack bookkeeping.
+            nseg = jnp.maximum(ev.args[A_LEN] >> 24, 1)
+            paylen = ev.args[A_LEN] & 0xFFFFFF
+            wire = paylen + jnp.where(paylen > 0, nseg, 1) * header
             unlimited = now < self.bootstrap_end
             # drop-tail against the NIC receive buffer (interfacebuffer,
             # options.c:132; 0 = unbounded). 'single' bounds the implicit
@@ -355,8 +366,8 @@ class Stack:
                 )
                 cap = cap.append(
                     now, ev.src, ev.dst, ev.args[A_SPORT], ev.args[A_DPORT],
-                    ev.args[A_META], ev.args[A_LEN], ev.args[A_SEQ],
-                    ev.args[A_ACK], stages,
+                    ev.args[A_META], ev.args[A_LEN] & 0xFFFFFF,
+                    ev.args[A_SEQ], ev.args[A_ACK], stages,
                 )
             hs = dataclasses.replace(
                 hs,

@@ -437,6 +437,28 @@ def _bit_vec(off, w: int):
     return jnp.where(sel, jnp.uint64(1) << bi, jnp.uint64(0))
 
 
+def _range_vec(lo, hi, w: int):
+    """[W]-word u64 mask of bits [lo, hi), clamped to [0, 64w).
+
+    The burst analog of _bit_vec: a fold of k contiguous segments marks
+    its whole run in one pass (all elementwise, per-word shift math)."""
+    lo = jnp.clip(lo, 0, 64 * w)
+    hi = jnp.clip(hi, 0, 64 * w)
+    idx = jnp.arange(w, dtype=_I32) * 64
+    a = jnp.clip(lo - idx, 0, 64)
+    b = jnp.clip(hi - idx, 0, 64)
+    n = jnp.maximum(b - a, 0)
+    ones = jnp.where(
+        n >= 64,
+        ~jnp.uint64(0),
+        (jnp.uint64(1) << jnp.minimum(n, 63).astype(jnp.uint64))
+        - jnp.uint64(1),
+    )
+    return jnp.where(
+        n > 0, ones << jnp.minimum(a, 63).astype(jnp.uint64), jnp.uint64(0)
+    )
+
+
 def _bit_test(ooo, off):
     """Is bit `off` set in the [W]-word bitmap? (off must be >= 0).
     One-hot select, not ooo[wi]: computed-index gathers serialize on
@@ -1017,8 +1039,16 @@ class TCP:
             ack_ok & pure & ~advanced
             & (row.snd_nxt > row.snd_una) & (ack == row.snd_una)
         )
-        dup_acks = jnp.where(advanced, 0, row.dup_acks + is_dup.astype(_I32))
-        fr = is_dup & (dup_acks == 3) & ~in_rec
+        # a pure dup ACK answering a burst-folded delivery stands for
+        # pkt.nseg per-segment dup ACKs (the reference receiver emits
+        # one per arriving segment) — count them all, and trigger fast
+        # retransmit on CROSSING the 3-dup threshold, since the counter
+        # can now jump past it in one step
+        dup_acks = jnp.where(
+            advanced, 0,
+            row.dup_acks + jnp.where(is_dup, pkt.nseg, 0),
+        )
+        fr = is_dup & (dup_acks >= 3) & (row.dup_acks < 3) & ~in_rec
         flight = (row.snd_nxt - row.snd_una).astype(jnp.float32)
         exit_rec = advanced & in_rec & (ack >= row.recover)
         partial_ack = advanced & in_rec & ~exit_rec
@@ -1035,10 +1065,14 @@ class TCP:
         cw_loss, ss_loss, wmax_loss, epoch_loss = self.cc.on_loss(
             row, flight, now
         )
+        # a carrier crossing the 3-dup threshold spends its remaining
+        # dups on recovery inflation, exactly as the unfolded per-dup
+        # stream would (dups #4.. each inflate cwnd by one segment)
+        fr_extra = jnp.maximum(dup_acks - 3, 0).astype(jnp.float32)
         cwnd = jnp.where(
-            fr, cw_loss,
+            fr, cw_loss + fr_extra,
             jnp.where(
-                is_dup & in_rec, row.cwnd + 1,
+                is_dup & in_rec, row.cwnd + pkt.nseg,
                 jnp.where(
                     exit_rec, row.ssthresh,
                     jnp.where(advanced & ~in_rec, cw_ack, row.cwnd),
@@ -1117,25 +1151,41 @@ class TCP:
         )
         wnd_words = row.ooo.shape[0]
         wnd_cap = 64 * wnd_words
+        # burst delivery: this packet may stand for pkt.nseg contiguous
+        # segments [seq, seq+nseg) totalling pkt.length bytes (the
+        # engine's stage fold; nseg == 1 for untouched packets). The
+        # whole run marks as a range mask; freshness is per bit, so a
+        # burst overlapping retransmitted/duplicate segments delivers
+        # exactly its new bits.
         off = pkt.seq - row.rcv_nxt
-        in_win = (off >= 0) & (off < wnd_cap)
-        bit = jnp.where(
-            in_win, _bit_vec(jnp.maximum(off, 0), wnd_words), jnp.uint64(0)
-        )
-        already = (off < 0) | (
+        end = off + pkt.nseg
+        rng = _range_vec(off, end, wnd_words)
+        new_bits = rng & ~row.ooo
+        any_new = jnp.any(new_bits != 0)
+        in_win = (end > 0) & (off < wnd_cap)
+        fresh = has_seg & in_win & any_new
+        # a burst's last segment is the only one the fold allows to be
+        # partial; its sequence slot carries the sub-MSS tail. The
+        # burst's FIRST segment may be a refill of the tracked partial
+        # (a stream boundary: the sender refilled the tail segment with
+        # the next stream's bytes and the fold chained full segments
+        # behind it) — the refill delta must not vanish inside the run.
+        last_seq = pkt.seq + pkt.nseg - 1
+        last_len = pkt.length - (pkt.nseg - 1) * MSS
+        first_len = jnp.where(pkt.nseg > 1, MSS, pkt.length)
+        first_already = (off < 0) | (
             in_win & _bit_test(row.ooo, jnp.maximum(off, 0))
         )
-        fresh = has_seg & in_win & ~already
         refill = (
-            has_seg & ~fresh & (pkt.length > 0)
-            & (pkt.seq == row.partial_seq) & (pkt.length > row.partial_len)
+            has_seg & (pkt.length > 0) & first_already
+            & (pkt.seq == row.partial_seq) & (first_len > row.partial_len)
         )
-        ooo1 = jnp.where(fresh, row.ooo | bit, row.ooo)
+        ooo1 = jnp.where(fresh, row.ooo | new_bits, row.ooo)
         adv = jnp.where(fresh, _trailing_ones_vec(ooo1), 0)
         rcv_nxt = row.rcv_nxt + adv
         ooo2 = _shift_right_vec(ooo1, adv)
         is_partial = (
-            has_seg & (pkt.length > 0) & (pkt.length < MSS) & (fresh | refill)
+            has_seg & (pkt.length > 0) & (last_len < MSS) & (fresh | refill)
         )
         if self.in_order:
             # bytes surface only as rcv_nxt advances: adv full segments,
@@ -1152,12 +1202,12 @@ class TCP:
                 MSS, 0,
             )
             new_bytes -= jnp.where(
-                fresh & is_partial & (pkt.seq < rcv_nxt),
-                MSS - pkt.length, 0,
+                fresh & is_partial & (last_seq < rcv_nxt),
+                MSS - last_len, 0,
             )
             prev_partial_adv = (
                 (row.partial_seq >= row.rcv_nxt)
-                & (row.partial_seq < rcv_nxt) & (row.partial_seq != pkt.seq)
+                & (row.partial_seq < rcv_nxt) & (row.partial_seq != last_seq)
             )
             new_bytes -= jnp.where(
                 prev_partial_adv, MSS - row.partial_len, 0
@@ -1167,16 +1217,28 @@ class TCP:
             # advance (partial_len below is updated either way)
             new_bytes += jnp.where(
                 refill & (row.partial_seq < row.rcv_nxt),
-                pkt.length - row.partial_len, 0,
+                first_len - row.partial_len, 0,
             )
             new_bytes = new_bytes.astype(_I32)
         else:
+            # per-bit freshness: a burst overlapping already-held
+            # segments delivers only its new bits. The partial tail
+            # counts its own length; every other fresh bit is full-MSS.
+            n_fresh = jnp.sum(
+                jax.lax.population_count(new_bits).astype(_I32)
+            )
+            last_bit_fresh = _bit_test(
+                new_bits, jnp.clip(last_seq - row.rcv_nxt, 0, wnd_cap - 1)
+            ) & (last_seq >= row.rcv_nxt)
+            burst_bytes = n_fresh * MSS - jnp.where(
+                (last_len < MSS) & last_bit_fresh, MSS - last_len, 0
+            )
             new_bytes = (
-                jnp.where(fresh, pkt.length, 0)
-                + jnp.where(refill, pkt.length - row.partial_len, 0)
+                jnp.where(fresh, burst_bytes, 0)
+                + jnp.where(refill, first_len - row.partial_len, 0)
             ).astype(_I32)
         clear_partial = (
-            has_seg & (pkt.seq == row.partial_seq) & (pkt.length >= MSS)
+            has_seg & (pkt.seq == row.partial_seq) & (first_len >= MSS)
         )
         rfin = jnp.where(has_seg & f_fin, pkt.seq, row.rfin_seq)
         consumed_before = (row.rfin_seq >= 0) & (row.rcv_nxt > row.rfin_seq)
@@ -1229,11 +1291,11 @@ class TCP:
             ooo=ooo2,
             rfin_seq=rfin,
             partial_seq=jnp.where(
-                is_partial, pkt.seq,
+                is_partial, last_seq,
                 jnp.where(clear_partial, -1, row.partial_seq),
             ),
             partial_len=jnp.where(
-                is_partial, pkt.length,
+                is_partial, last_len,
                 jnp.where(clear_partial, 0, row.partial_len),
             ),
         )
@@ -1252,7 +1314,7 @@ class TCP:
         in_order_fresh = fresh & (off == 0)
         delay_ok = (
             jnp.asarray(self.delack) & has_seg & in_order_fresh & ~f_fin
-            & ~fin_new & (row.delack_segs == 0)
+            & ~fin_new & (row.delack_segs == 0) & (pkt.nseg == 1)
         )
         send_ack = (has_seg & ~delay_ok) | dup_syn
         arm_delack = delay_ok & ~row.delack_live
@@ -1309,7 +1371,15 @@ class TCP:
             dt=jnp.where(need_ctl, fin_t2 - now, 0),
             kind=KIND_PKT_ARRIVE,
             args=_pkt_args(
-                pkt.dst_port, pkt.src_port, seq=0, ack=ctl_ack, length=0,
+                pkt.dst_port, pkt.src_port, seq=0, ack=ctl_ack,
+                # a dup/data ACK answering an nseg-fold represents nseg
+                # per-segment ACKs: the count rides the length word's
+                # high bits (low 24 bits stay 0 = no payload) so the
+                # sender's dup-ack ladder advances as if unfolded
+                length=jnp.where(
+                    need_synack | (pkt.nseg <= 1), 0,
+                    pkt.nseg.astype(jnp.int32) << 24,
+                ),
                 wnd=row.rwnd, aux=ctl_aux, flags=ctl_flags,
                 sack=row.ooo[0],
             ),

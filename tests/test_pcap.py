@@ -59,7 +59,10 @@ def _parse_pcap(path):
 
 def test_logpcap_produces_capture(tmp_path):
     cfg = parse_config(_cfg(tmp_path))
-    sim = build_simulation(cfg, seed=4)
+    # burst folding coarsens captures to one record per folded run;
+    # this test asserts PER-SEGMENT capture granularity, the fidelity
+    # mode an operator doing packet-level analysis would run in
+    sim = build_simulation(cfg, seed=4, burst_rx=False)
     assert sim.pcap_gids, "logpcap host not registered for capture"
     st = sim.run()
     drain = CaptureDrain(
