@@ -134,7 +134,7 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
         st = init()
         t0 = time.perf_counter()
         st = run(st, jnp.int64(stop_s * SECOND))
-        executed = int(jax.device_get(st.stats.n_executed.sum()))
+        executed = int(jax.device_get(st.stats.n_executed).sum())
         wall = time.perf_counter() - t0
         if wall > 0.05:
             break
@@ -154,7 +154,7 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
         # events/sweep is what the batched drain buys
         "sweeps": sweeps,
         "events_per_sweep": round(executed / max(sweeps, 1), 1),
-        "drops": int(st.queues.drops.sum()),
+        "drops": int(jax.device_get(st.queues.drops).sum()),
         "device": str(dev.device_kind),
         "n_hosts": N_HOSTS,
         "drain": "batched" if batched else "sequential",
@@ -281,12 +281,14 @@ def tor_worker():
         k += chunk_ns
     # every device fetch stays inside the timed/faultable region so a
     # late fault cannot discard an already-measured result upstream
-    n_streams = int(jax.device_get(st.hosts.app.streams_done.sum()))
-    relayed = int(jax.device_get(st.hosts.app.relayed_bytes.sum()))
+    # sums are padding-safe (bucket pad rows stay 0 — see btc_worker's
+    # best_min note for the min-reduction trap)
+    n_streams = int(jax.device_get(st.hosts.app.streams_done).sum())
+    relayed = int(jax.device_get(st.hosts.app.relayed_bytes).sum())
     # scheduler self-profiling (scheduler.c:266-271 analog): the r04
     # verdict's ask — sweeps/windows/inner-steps make the per-sweep
     # fixed cost attributable instead of guessed at
-    n_events = int(jax.device_get(st.stats.n_executed.sum()))
+    n_events = int(jax.device_get(st.stats.n_executed).sum())
     sweeps = int(jax.device_get(st.stats.n_sweeps))
     inner = int(jax.device_get(st.stats.n_inner_steps))
     windows = int(jax.device_get(st.stats.n_windows))
@@ -338,7 +340,13 @@ def btc_worker():
     st = sim.run(chunk_s * SECOND)
     for k in range(2 * chunk_s, stop_s + chunk_s, chunk_s):
         st = sim.run(min(k, stop_s) * SECOND, state=st)
-    best_min = int(jax.device_get(st.hosts.app.best.min()))
+    # slice to the REAL hosts before reducing: shape bucketing pads
+    # 1000 nodes to 1024 rows, and the inert pad rows hold best=0
+    # forever — an unsliced min() reported "blocks_everywhere: 0" for
+    # two rounds while every actual node held every block. Min-type
+    # reductions are the only ones padding can poison (sums see 0s).
+    best = jax.device_get(st.hosts.app.best)[: len(sim.names)]
+    best_min = int(best.min())
     wall = time.perf_counter() - t0
     _stamp(f"btc timed run done in {wall:.2f}s")
     print(json.dumps({

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Sequential bench measurement + compile banking on the real chip.
+# Each worker runs in its own process; stdout JSON accumulates in
+# measure_results.jsonl, stage stamps in measure_stamps.log.
+cd /root/repo
+R=measure_results.jsonl
+S=measure_stamps.log
+: > "$R"; : > "$S"
+run() { # run <name> <timeout_s> <worker-flag> [ENV=VAL ...]
+  local name=$1 tmo=$2 flag=$3; shift 3
+  echo "=== $name start $(date +%H:%M:%S)" >> "$S"
+  echo "{\"stage\": \"$name\"}" >> "$R"
+  timeout "$tmo" env "$@" python bench.py "$flag" >> "$R" 2>> "$S"
+  echo "=== $name exit=$? $(date +%H:%M:%S)" >> "$S"
+}
+run tor0      1500 --tor-worker      BENCH_TOR_TIER=0
+run tor1      1800 --tor-worker      BENCH_TOR_TIER=1
+run tor2      2400 --tor-worker      BENCH_TOR_TIER=2
+run tor3      3600 --tor-worker      BENCH_TOR_TIER=3
+run tor0nocpu 1500 --tor-worker      BENCH_TOR_TIER=0 BENCH_TOR_CPU=0
+run btc       1800 --btc-worker
+run phold     900  --phold-worker    BENCH_STOP_S=20
+run phold16k  1200 --phold-big-worker BENCH_STOP_S=20
+run skew      900  --skew-worker
+echo ALL_DONE >> "$S"

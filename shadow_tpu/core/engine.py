@@ -61,6 +61,14 @@ from shadow_tpu.core.events import (
 )
 from shadow_tpu.core.timebase import TIME_INVALID
 
+# Burst-fold length-word layout: low bits payload total, high bits the
+# folded-run segment count. Every packer/unpacker (the fold below, the
+# stack's Pkt decode and wire accounting, tcp's dup-ACK carrier) derives
+# from these; the stage-width guard in EngineConfig enforces NSEG_MAX.
+BURST_NSEG_SHIFT = 24
+BURST_LEN_MASK = (1 << BURST_NSEG_SHIFT) - 1
+BURST_NSEG_MAX = 127  # bits 24..30; bit 31 is the i32 sign
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -198,7 +206,12 @@ class EngineConfig:
     # bursts are most of it. None disables. The tuple is a static
     # descriptor supplied by the stack layer:
     #   (kind, seq_arg, len_arg, sport_arg, dport_arg, meta_arg,
-    #    proto, flags_excl_mask, mss, ack_arg, wnd_arg, aux_arg)
+    #    proto, flags_excl_mask, mss, ctl_cols)
+    # ctl_cols: arg indices whose folded value comes from the run's
+    # LAST (highest-seq) member as one consistent snapshot — cumulative
+    # ack, window advertisement, ts echo, and the SACK words, whose
+    # bits are relative to their own segment's ack and must never be
+    # paired with another segment's ack value.
     # Eligible events (matching kind/proto, none of the excluded flags,
     # 0 < len <= mss) that form a strictly seq-contiguous run of one
     # (src, sport, dport) flow collapse into the run head: its length
@@ -225,14 +238,14 @@ class EngineConfig:
             raise ValueError(
                 f"route_bucket must be >= 0, got {self.route_bucket}"
             )
-        if self.burst is not None and self.eff_stage_width > 127:
+        if self.burst is not None and self.eff_stage_width > BURST_NSEG_MAX:
             # the fold packs its run count into bits 24..30 of the
             # length word; a wider staging buffer could form runs that
             # silently overflow into the sign bit — refuse loudly
             raise ValueError(
-                f"burst folding requires stage_width <= 127 (got "
-                f"{self.eff_stage_width}); shrink drain_batch/stage_width "
-                "or disable burst"
+                f"burst folding requires stage_width <= {BURST_NSEG_MAX} "
+                f"(got {self.eff_stage_width}); shrink drain_batch/"
+                "stage_width or disable burst"
             )
         if self.stage_width and self.stage_width < self.eff_drain_batch + self.max_emit:
             # staging must hold a full frontier dump plus one handler's
@@ -718,7 +731,7 @@ class Engine:
         (_stage_min selects by content, _stage_append by free rank).
         """
         (kind, seq_a, len_a, sport_a, dport_a, meta_a, proto, flags_x,
-         mss, ack_a, wnd_a, aux_a) = self.cfg.burst
+         mss, ctl_cols) = self.cfg.burst
         t = stage.time
         h, sw = t.shape
         meta = stage.args[:, :, meta_a]
@@ -776,20 +789,27 @@ class Engine:
         folded_head = start & (count > 1)
         absorbed = elig2 & contig & (count > 1)
         args2 = args2.at[:, :, len_a].set(
-            jnp.where(folded_head, total | (count << 24), ln2)
+            jnp.where(
+                folded_head, total | (count << BURST_NSEG_SHIFT), ln2
+            )
         )
-        # the head keeps the run's FRESHEST piggybacked control state:
-        # later segments carry strictly newer cumulative acks, window
-        # advertisements, and timestamps — dropping them would lag the
-        # peer's snd_una/rwnd/RTT by up to a burst
-        i32min = jnp.iinfo(jnp.int32).min
-        for col in (ack_a, wnd_a, aux_a):
+        # the head takes the run's LAST member's piggybacked control
+        # words as ONE consistent snapshot: the freshest cumulative
+        # ack/window/ts, and the SACK words that are only meaningful
+        # relative to that same segment's ack
+        idx2 = jnp.arange(sw, dtype=jnp.int32)[None, None, :]
+        endpos = jnp.max(
+            jnp.where(same, idx2, -1), axis=2
+        )  # [H, SW] index of each run's last member
+        at_end = idx2 == endpos[:, :, None]  # [H, SW, SW] one-hot
+        for col in ctl_cols:
             v = args2[:, :, col]
-            vmax = jnp.max(
-                jnp.where(same, v[:, None, :], i32min), axis=2
+            vend = jnp.sum(
+                jnp.where(at_end & same, v[:, None, :], 0),
+                axis=2, dtype=v.dtype,
             )
             args2 = args2.at[:, :, col].set(
-                jnp.where(folded_head, vmax, v)
+                jnp.where(folded_head, vend, v)
             )
         return Events(
             time=jnp.where(
@@ -1022,7 +1042,8 @@ class Engine:
                         bkind, _sq, blen = self.cfg.burst[:3]
                         lw = ev.args[:, blen]
                         nseg = jnp.where(
-                            (lw & 0xFFFFFF) > 0, jnp.maximum(lw >> 24, 1), 1
+                            (lw & BURST_LEN_MASK) > 0,
+                            jnp.maximum(lw >> BURST_NSEG_SHIFT, 1), 1,
                         )
                         ev_cost = ev_cost * jnp.where(
                             ev.kind == bkind, nseg.astype(ev_cost.dtype), 1

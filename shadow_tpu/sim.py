@@ -739,15 +739,15 @@ def build_simulation(
     burst = None
     if burst_rx and fuse_rx and tcp is not None:
         from shadow_tpu.transport.stack import (
-            A_ACK, A_AUX, A_DPORT, A_LEN, A_META, A_SEQ, A_SPORT, A_WND,
-            F_FIN, F_RST, F_SYN, KIND_PKT_ARRIVE,
+            A_ACK, A_AUX, A_DPORT, A_LEN, A_META, A_SACK0, A_SACK1,
+            A_SEQ, A_SPORT, A_WND, F_FIN, F_RST, F_SYN, KIND_PKT_ARRIVE,
         )
         from shadow_tpu.host.sockets import PROTO_TCP
         from shadow_tpu.transport.tcp import MSS
 
         burst = (KIND_PKT_ARRIVE, A_SEQ, A_LEN, A_SPORT, A_DPORT, A_META,
                  int(PROTO_TCP), int(F_SYN | F_FIN | F_RST), int(MSS),
-                 A_ACK, A_WND, A_AUX)
+                 (A_ACK, A_WND, A_AUX, A_SACK0, A_SACK1))
     ecfg = EngineConfig(
         n_hosts=per_shard, capacity=capacity, lookahead=lookahead,
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,

@@ -34,7 +34,9 @@ import jax
 import jax.numpy as jnp
 
 from shadow_tpu.core import rng as srng
-from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.engine import (
+    BURST_LEN_MASK, BURST_NSEG_SHIFT, Emit,
+)
 from shadow_tpu.core.events import Events
 from shadow_tpu.host.nic import HEADER_TCP, HEADER_UDP, MTU, NIC, CoDel
 from shadow_tpu.host.sockets import PROTO_TCP, PROTO_UDP, SocketTable
@@ -98,10 +100,10 @@ class Pkt:
             dst_port=a[A_DPORT],
             seq=a[A_SEQ],
             ack=a[A_ACK],
-            length=a[A_LEN] & 0xFFFFFF,
+            length=a[A_LEN] & BURST_LEN_MASK,
             wnd=a[A_WND],
             aux=a[A_AUX],
-            nseg=jnp.maximum(a[A_LEN] >> 24, 1),
+            nseg=jnp.maximum(a[A_LEN] >> BURST_NSEG_SHIFT, 1),
             sack=(
                 a[A_SACK0].astype(jnp.uint32).astype(jnp.uint64)
                 | (a[A_SACK1].astype(jnp.uint32).astype(jnp.uint64) << 32)
@@ -309,8 +311,8 @@ class Stack:
             # payload is the run's total and each segment pays a header.
             # A zero-payload packet with a count (a dup ACK answering a
             # fold) is ONE wire packet — the count is ack bookkeeping.
-            nseg = jnp.maximum(ev.args[A_LEN] >> 24, 1)
-            paylen = ev.args[A_LEN] & 0xFFFFFF
+            nseg = jnp.maximum(ev.args[A_LEN] >> BURST_NSEG_SHIFT, 1)
+            paylen = ev.args[A_LEN] & BURST_LEN_MASK
             wire = paylen + jnp.where(paylen > 0, nseg, 1) * header
             unlimited = now < self.bootstrap_end
             # drop-tail against the NIC receive buffer (interfacebuffer,
@@ -366,7 +368,7 @@ class Stack:
                 )
                 cap = cap.append(
                     now, ev.src, ev.dst, ev.args[A_SPORT], ev.args[A_DPORT],
-                    ev.args[A_META], ev.args[A_LEN] & 0xFFFFFF,
+                    ev.args[A_META], ev.args[A_LEN] & BURST_LEN_MASK,
                     ev.args[A_SEQ], ev.args[A_ACK], stages,
                 )
             hs = dataclasses.replace(

@@ -73,7 +73,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from shadow_tpu.core.engine import Emit
+from shadow_tpu.core.engine import BURST_NSEG_SHIFT, Emit
 from shadow_tpu.core.timebase import MILLISECOND, SECOND
 from shadow_tpu.host.nic import HEADER_TCP, MTU
 from shadow_tpu.host.sockets import PROTO_NONE, PROTO_TCP, PROTO_UDP
@@ -1172,14 +1172,24 @@ class TCP:
         # behind it) — the refill delta must not vanish inside the run.
         last_seq = pkt.seq + pkt.nseg - 1
         last_len = pkt.length - (pkt.nseg - 1) * MSS
-        first_len = jnp.where(pkt.nseg > 1, MSS, pkt.length)
-        first_already = (off < 0) | (
-            in_win & _bit_test(row.ooo, jnp.maximum(off, 0))
+        # refill: the tracked partial slot may be ANY member of the run
+        # (head: the classic single-segment refill; middle: a go-back-N
+        # retransmit burst re-sending the refilled slot at full MSS;
+        # tail: a refilled-but-still-partial slot). Its delta counts iff
+        # that slot's bit is already held — a fresh bit delivers through
+        # the normal per-bit path instead.
+        p_seq = row.partial_seq
+        p_in_run = (
+            has_seg & (pkt.length > 0) & (p_seq >= 0)
+            & (p_seq >= pkt.seq) & (p_seq <= last_seq)
         )
-        refill = (
-            has_seg & (pkt.length > 0) & first_already
-            & (pkt.seq == row.partial_seq) & (first_len > row.partial_len)
+        p_off = p_seq - row.rcv_nxt
+        p_already = (p_off < 0) | (
+            (p_off < wnd_cap) & _bit_test(row.ooo, jnp.maximum(p_off, 0))
         )
+        p_member_len = jnp.where(p_seq == last_seq, last_len, MSS)
+        refill = p_in_run & p_already & (p_member_len > row.partial_len)
+        refill_delta = jnp.where(refill, p_member_len - row.partial_len, 0)
         ooo1 = jnp.where(fresh, row.ooo | new_bits, row.ooo)
         adv = jnp.where(fresh, _trailing_ones_vec(ooo1), 0)
         rcv_nxt = row.rcv_nxt + adv
@@ -1217,7 +1227,7 @@ class TCP:
             # advance (partial_len below is updated either way)
             new_bytes += jnp.where(
                 refill & (row.partial_seq < row.rcv_nxt),
-                first_len - row.partial_len, 0,
+                refill_delta, 0,
             )
             new_bytes = new_bytes.astype(_I32)
         else:
@@ -1234,12 +1244,9 @@ class TCP:
                 (last_len < MSS) & last_bit_fresh, MSS - last_len, 0
             )
             new_bytes = (
-                jnp.where(fresh, burst_bytes, 0)
-                + jnp.where(refill, first_len - row.partial_len, 0)
+                jnp.where(fresh, burst_bytes, 0) + refill_delta
             ).astype(_I32)
-        clear_partial = (
-            has_seg & (pkt.seq == row.partial_seq) & (first_len >= MSS)
-        )
+        clear_partial = p_in_run & (p_member_len >= MSS)
         rfin = jnp.where(has_seg & f_fin, pkt.seq, row.rfin_seq)
         consumed_before = (row.rfin_seq >= 0) & (row.rcv_nxt > row.rfin_seq)
         consumed_after = (rfin >= 0) & (rcv_nxt > rfin)
@@ -1378,7 +1385,7 @@ class TCP:
                 # sender's dup-ack ladder advances as if unfolded
                 length=jnp.where(
                     need_synack | (pkt.nseg <= 1), 0,
-                    pkt.nseg.astype(jnp.int32) << 24,
+                    pkt.nseg.astype(jnp.int32) << BURST_NSEG_SHIFT,
                 ),
                 wnd=row.rwnd, aux=ctl_aux, flags=ctl_flags,
                 sack=row.ooo[0],

@@ -26,11 +26,14 @@ jax.config.update("jax_platforms", "cpu")
 # compiles). Cache entries are keyed on HLO hash, so identical
 # (shape, handler-table) engines across tests and across runs share one
 # compile. Same mechanism bench.py uses on the TPU backend — but in a
-# SEPARATE directory: sharing one cache dir between the axon/TPU bench
-# and the CPU suite has produced cross-machine CPU AOT loads whose
-# feature mismatch the loader itself flags as able to cause "execution
-# errors" (observed once as silently wrong simulation results —
-# "missing: 28" from a bitcoin run whose rerun gave the correct 0).
+# SEPARATE directory, as hygiene: when the suite shared the bench's
+# cache dir, one bitcoin run returned a silently wrong answer
+# ("missing: 28" where the reconfirmed answer is 0) while the loader
+# was warning about CPU AOT machine-feature mismatches. The warnings
+# themselves are largely noise (XLA appends pseudo-features like
+# prefer-no-scatter to the compile-machine list, which no host CPUID
+# reports), so causality is unconfirmed — but backend-separated caches
+# remove the one suspect mechanism and cost nothing.
 _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache_cpu")
 os.makedirs(_cache_dir, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
