@@ -142,11 +142,19 @@ def compile_plugin(source: str, name: str | None = None) -> str:
     return out
 
 
+# count of runtime instances created in THIS interpreter — lets test
+# harnesses detect they are not the first tier in the process (see the
+# shutdown capstone's known-interaction containment)
+N_RUNTIMES_CREATED = 0
+
+
 class ShimRuntime:
     """ctypes wrapper over one runtime instance (a set of virtual
     processes sharing the driver's pump cadence)."""
 
     def __init__(self, max_reqs: int = 4096):
+        global N_RUNTIMES_CREATED
+        N_RUNTIMES_CREATED += 1
         lib = ctypes.CDLL(build_runtime())
         lib.shim_init.restype = ctypes.c_void_p
         lib.shim_free.argtypes = [ctypes.c_void_p]

@@ -38,6 +38,28 @@ TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 </graphml>"""
 
 
+def _rerun_in_fresh_process(test_name: str) -> bool:
+    """Containment for the sockbuf<->shutdown interaction: when any
+    tier already ran in this interpreter, re-execute the named capstone
+    in a fresh subprocess (the solo conditions it is known green under)
+    and report the child's verdict. Returns True when the child ran.
+    See the shutdown capstone's docstring for the interaction notes."""
+    import subprocess
+    import sys
+
+    from shadow_tpu.proc import native as _native
+    if _native.N_RUNTIMES_CREATED == 0:
+        return False
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         f"tests/test_ref_capstones.py::{test_name}"],
+        capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-1000:])
+    return True
+
+
 def _run_one(ref_src: str, name: str, seed: int):
     from shadow_tpu.proc import ProcessTier
     from shadow_tpu.proc.native import compile_posix_plugin
@@ -98,6 +120,8 @@ def test_reference_test_sockbuf_unmodified(capfd):
     src = "/root/reference/src/test/sockbuf/test_sockbuf.c"
     if not os.path.exists(src):
         pytest.skip("reference tree not mounted")
+    if _rerun_in_fresh_process("test_reference_test_sockbuf_unmodified"):
+        return
     plug = compile_posix_plugin(
         src, name="ref_test_sockbuf",
         extra_sources=["/root/reference/src/test/test_common.c"],
@@ -117,6 +141,61 @@ def test_reference_test_sockbuf_unmodified(capfd):
     assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2500:])
     assert "sockbuf test passed" in out
     tier.close()
+
+
+def test_reference_test_shutdown_unmodified(capfd):
+    """src/test/shutdown/test_shutdown.c (+ test_common.c): real
+    shutdown(2) half-close on the TCP machinery — ENOTCONN before
+    connect and on UDP, EINVAL on a bad `how`, SHUT_RD reading buffered
+    bytes then EOF while sends continue, SHUT_WR sending the FIN after
+    queued data drains with later sends failing EPIPE (SIGPIPE ignored
+    by the test), all over a single-process loopback trio.
+
+    KNOWN INTERACTION: running this capstone and the sockbuf capstone
+    in ONE pytest process hangs whichever runs second — only under
+    pytest (the identical back-to-back harness sequence completes in a
+    plain python process), implicating pytest's capfd context plus the
+    shared green-thread runtime. Containment: when another tier already
+    ran in this process, this test re-executes itself in a fresh
+    subprocess interpreter, which reproduces the solo conditions it is
+    known green under."""
+    src = "/root/reference/src/test/shutdown/test_shutdown.c"
+    if not os.path.exists(src):
+        # skip BEFORE the re-exec branch: a child pytest would report
+        # its skip as exit 0 and masquerade as a pass
+        pytest.skip("reference tree not mounted")
+    if _rerun_in_fresh_process("test_reference_test_shutdown_unmodified"):
+        return
+    from shadow_tpu.proc import ProcessTier
+    from shadow_tpu.proc.native import compile_posix_plugin
+    plug = compile_posix_plugin(
+        src, name="ref_test_shutdown",
+        extra_sources=["/root/reference/src/test/test_common.c"],
+        include_dirs=["/root/reference/src"],
+    )
+    # 1ms loopback: the test usleeps 10ms and expects in-flight bytes to
+    # have been delivered by then (it was written for a fast loopback)
+    topo_fast = TOPO.replace(
+        '<data key="d3">25.0</data>', '<data key="d3">1.0</data>'
+    )
+    cfg = parse_config(textwrap.dedent(f"""\
+    <shadow stoptime="60">
+      <topology><![CDATA[{topo_fast}]]></topology>
+      <plugin id="ref_test_shutdown" path="{plug}"/>
+      <host id="h0">
+        <process plugin="ref_test_shutdown" starttime="1" arguments=""/>
+      </host>
+    </shadow>"""))
+    # nine sequential listener/client/child trios; close handshakes
+    # recycle slots only once they complete, so give the table headroom
+    tier = ProcessTier(cfg, seed=11, n_sockets=48)
+    tier.run()
+    out = capfd.readouterr().out
+    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2500:])
+    assert "shutdown test passed" in out
+    tier.close()
+
+
 
 
 def test_reference_test_sleep_unmodified(capfd):
@@ -359,47 +438,6 @@ def test_dup_family(capfd):
     assert "DUP_OK" in out
     tier.close()
     os.remove(src)
-
-
-def test_reference_test_shutdown_unmodified(capfd):
-    """src/test/shutdown/test_shutdown.c (+ test_common.c): real
-    shutdown(2) half-close on the TCP machinery — ENOTCONN before
-    connect and on UDP, EINVAL on a bad `how`, SHUT_RD reading buffered
-    bytes then EOF while sends continue, SHUT_WR sending the FIN after
-    queued data drains with later sends failing EPIPE (SIGPIPE ignored
-    by the test), all over a single-process loopback trio."""
-    from shadow_tpu.proc import ProcessTier
-    from shadow_tpu.proc.native import compile_posix_plugin
-
-    src = "/root/reference/src/test/shutdown/test_shutdown.c"
-    if not os.path.exists(src):
-        pytest.skip("reference tree not mounted")
-    plug = compile_posix_plugin(
-        src, name="ref_test_shutdown",
-        extra_sources=["/root/reference/src/test/test_common.c"],
-        include_dirs=["/root/reference/src"],
-    )
-    # 1ms loopback: the test usleeps 10ms and expects in-flight bytes to
-    # have been delivered by then (it was written for a fast loopback)
-    topo_fast = TOPO.replace(
-        '<data key="d3">25.0</data>', '<data key="d3">1.0</data>'
-    )
-    cfg = parse_config(textwrap.dedent(f"""\
-    <shadow stoptime="60">
-      <topology><![CDATA[{topo_fast}]]></topology>
-      <plugin id="ref_test_shutdown" path="{plug}"/>
-      <host id="h0">
-        <process plugin="ref_test_shutdown" starttime="1" arguments=""/>
-      </host>
-    </shadow>"""))
-    # nine sequential listener/client/child trios; close handshakes
-    # recycle slots only once they complete, so give the table headroom
-    tier = ProcessTier(cfg, seed=11, n_sockets=48)
-    tier.run()
-    out = capfd.readouterr().out
-    assert tier.exit_codes == {0: 0}, (tier.exit_codes, out[-2500:])
-    assert "shutdown test passed" in out
-    tier.close()
 
 
 def test_reference_test_bind_unmodified(capfd):
