@@ -194,6 +194,9 @@ class ProcessTier:
         h_n = (self.sim.engine.cfg.n_hosts
                * self.sim.engine.cfg.n_shards)
         self._prev_udp_cnt = np.zeros((h_n,), np.int32)
+        # (gid, port) -> (pid, fd) for EXITED senders whose in-flight
+        # datagrams still need payload attribution at the ring drain
+        self._udp_src_zombies: dict[tuple[int, int], tuple[int, int]] = {}
         self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
         self._prev_fin = np.zeros((h_n, n_sockets), bool)
         # vectorized-observe state: endpoint membership, per-slot owed
@@ -221,6 +224,20 @@ class ProcessTier:
                 "n_sockets or driver_slots)"
             )
         return s
+
+    def _close_udp_ep(self, key, rows) -> None:
+        """Tear down one UDP endpoint (exit/close/stoptime-kill share
+        this): free the driver slot, clear the DESTINATION demux row —
+        arrivals addressed to it now drop, kernel semantics — but keep
+        SOURCE attribution for datagrams already sent: the ring drain
+        needs (pid, fd) to locate the payload stash (the runtime keeps
+        fds entries until shim_free), and dropping it lost a server's
+        final reply when it echoed then returned from main()."""
+        gid, slot, port = self.udp_eps.pop(key)
+        self.udp_port.pop((gid, port), None)
+        self._udp_src_zombies[(gid, port)] = key
+        self._free_slots.setdefault(gid, []).append(slot)
+        rows.append((gid, [CMD_UDP_CLOSE, slot]))
 
     def _register_ep(self, gid: int, slot: int, pid: int, fd: int,
                      driver_owned: bool) -> None:
@@ -353,6 +370,10 @@ class ProcessTier:
                 slot = self._alloc_slot(gid)
                 self.udp_eps[(pid, fd)] = (gid, slot, int(r.port))
                 self.udp_port[(gid, int(r.port))] = (pid, fd)
+                # a re-bound port supersedes any exited sender's zombie:
+                # without this, the drain could attribute the NEW
+                # process's in-flight datagrams to the old one's stash
+                self._udp_src_zombies.pop((gid, int(r.port)), None)
                 rows.append((gid, [CMD_UDP_BIND, slot, int(r.port)]))
             elif r.op == REQ_SENDTO:
                 ep = self.udp_eps.get((pid, fd))
@@ -392,10 +413,7 @@ class ProcessTier:
                         # OWN turnover and be torn down by observe
                         self._prev_gen[gid, slot] += 1
                 elif key in self.udp_eps:
-                    gid, slot, port = self.udp_eps.pop(key)
-                    self.udp_port.pop((gid, port), None)
-                    self._free_slots.setdefault(gid, []).append(slot)
-                    rows.append((gid, [CMD_UDP_CLOSE, slot]))
+                    self._close_udp_ep(key, rows)
                 elif key in self.slot_of:
                     gid, slot = self.slot_of[key]
                     rows.append((gid, [CMD_CLOSE, slot]))
@@ -422,10 +440,7 @@ class ProcessTier:
                     if p_pid == pid:
                         rows.append((gid, [CMD_CLOSE, slot]))
                 for key in [k for k in self.udp_eps if k[0] == pid]:
-                    gid, slot, port = self.udp_eps.pop(key)
-                    self.udp_port.pop((gid, port), None)
-                    self._free_slots.setdefault(gid, []).append(slot)
-                    rows.append((gid, [CMD_UDP_CLOSE, slot]))
+                    self._close_udp_ep(key, rows)
         return rows
 
     # ------------------------------------------------------------- inject
@@ -505,9 +520,9 @@ class ProcessTier:
                 for i in range(lo, hi):
                     k = i % UDP_RING
                     dst_ep = self.udp_port.get((g, int(udport[g, k])))
-                    src_ep = self.udp_port.get(
-                        (int(usrc[g, k]), int(usport[g, k]))
-                    )
+                    src_key = (int(usrc[g, k]), int(usport[g, k]))
+                    src_ep = (self.udp_port.get(src_key)
+                              or self._udp_src_zombies.get(src_key))
                     if dst_ep is None or src_ep is None:
                         continue  # endpoint closed while in flight
                     self.rt.udp_deliver(
@@ -639,10 +654,7 @@ class ProcessTier:
                 # and its datagram sockets (no handshake to run down:
                 # free the slot and clear the demux row immediately)
                 for key in [k for k in self.udp_eps if k[0] == pid]:
-                    gid, slot, port = self.udp_eps.pop(key)
-                    self.udp_port.pop((gid, port), None)
-                    self._free_slots.setdefault(gid, []).append(slot)
-                    stop_rows.append((gid, [CMD_UDP_CLOSE, slot]))
+                    self._close_udp_ep(key, stop_rows)
             if stop_rows:
                 st = self._inject(st, stop_rows, now)
             while self._wakes and self._wakes[0][0] <= now:

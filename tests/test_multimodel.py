@@ -61,8 +61,10 @@ def test_mixed_tgen_phold_runs_both_models():
     assert int(tgen_state.conn_rx[1]) >= 4096
     # phold peers kept the message population alive (4 peers x load 3)
     assert int(phold_state.n_recv[2:].sum()) > 50
-    # models never bled into each other's hosts
-    assert st.hosts.app.model_id.tolist() == [0, 0, 1, 1, 1, 1]
+    # models never bled into each other's hosts (slice to the real
+    # hosts: shape bucketing pads the row dimension with inert hosts)
+    n = len(sim.names)
+    assert st.hosts.app.model_id[:n].tolist() == [0, 0, 1, 1, 1, 1]
     assert int(phold_state.n_recv[:2].sum()) == 0
     assert int(tgen_state.streams_done[2:].sum()) == 0
 
