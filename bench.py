@@ -311,6 +311,59 @@ def tor_worker():
     }))
 
 
+def tor_churn_worker():
+    """Secondary metric: the Tor workload under relay churn — a fifth of
+    the relays crash and restart on a 20 s cycle (the dynamic-overlay
+    scenario the reference cannot express; its packetloss is frozen at
+    topology load). Reports surviving-stream throughput plus the fault
+    attribution counters, so the churn run is checked for both liveness
+    (streams still finish) and accounting (every drop attributed)."""
+    _enable_compile_cache()
+    import jax
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.timebase import SECOND
+    from shadow_tpu.examples import tor_churn_example
+
+    relays, clients, servers = TOR_TIERS[0]
+    stop_s = int(os.environ.get("BENCH_TOR_STOP_S", 30))
+    _stamp(f"tor churn {relays}/{clients}/{servers}: building")
+    cfg = parse_config(tor_churn_example(
+        n_relays_per_class=relays, n_clients=clients, n_servers=servers,
+        filesize="64KiB", count=2, stoptime=stop_s,
+        churn_frac=0.3, churn_period=15.0, churn_downtime=4.0,
+        churn_start=6.0,
+    ))
+    sim = _build_on_cpu(cfg, seed=1, n_sockets=32, capacity=768)
+    sim.strict_overflow = False
+    _stamp("build done; compiling + first chunk")
+    chunk_ns = SECOND
+    st = sim.run(chunk_ns)
+    jax.block_until_ready(st.now)
+    _stamp("compile banked; timed chunked run")
+    stop_ns = stop_s * SECOND
+    t0 = time.perf_counter()
+    st = sim.run(chunk_ns)
+    k = 2 * chunk_ns
+    while k < stop_ns + chunk_ns:
+        st = sim.run(min(k, stop_ns), state=st)
+        k += chunk_ns
+    n_streams = int(jax.device_get(st.hosts.app.streams_done).sum())
+    n_events = int(jax.device_get(st.stats.n_executed).sum())
+    fault_drops = int(jax.device_get(st.stats.n_fault_dropped).sum())
+    quarantined = int(jax.device_get(st.stats.n_quarantined).sum())
+    wall = time.perf_counter() - t0
+    _stamp(f"timed churn run done in {wall:.2f}s")
+    print(json.dumps({
+        "torchurn_hosts": len(sim.names),
+        "torchurn_sim_s_per_wall_s": round(stop_s / max(wall, 1e-9), 3),
+        "torchurn_streams_done": n_streams,
+        "torchurn_events": n_events,
+        "torchurn_fault_drops": fault_drops,
+        "torchurn_quarantined": quarantined,
+    }))
+
+
 def btc_worker():
     """Secondary metric: Bitcoin gossip (BASELINE config 5 shape).
     Chunked like tor_worker: the axon tunnel kills long single device
@@ -429,6 +482,7 @@ def skew_worker():
 
 def main():
     for flag, fn in (("--tor-worker", tor_worker),
+                     ("--tor-churn-worker", tor_churn_worker),
                      ("--btc-worker", btc_worker),
                      ("--phold-worker", phold_worker),
                      ("--phold-big-worker", phold_big_worker),
@@ -523,6 +577,13 @@ def main():
         os.environ.pop("BENCH_TOR_CPU", None)
         if rc:
             out.update(rc)
+            print(json.dumps(out), flush=True)
+    if tor_ok:
+        # churn variant at the smallest tier: liveness + drop attribution
+        # under relay crash/restart cycles
+        rch = run_secondary("--tor-churn-worker", nominal_timeout=420)
+        if rch:
+            out.update(rch)
             print(json.dumps(out), flush=True)
     rb = run_secondary("--btc-worker")
     if rb:

@@ -114,6 +114,7 @@ REAL(int, fcntl, (int, int, ...))
 REAL(int, ioctl, (int, unsigned long, ...))
 REAL(int, dup, (int))
 REAL(int, dup2, (int, int))
+REAL(int, dup3, (int, int, int))
 
 /* -------------------------------------------------- per-process vfds */
 
@@ -1252,7 +1253,13 @@ int dup3(int oldfd, int newfd, int flags) {
             validated BEFORE newfd is disturbed, both branches */
         return -1;
     }
-    /* O_CLOEXEC itself is a no-op: no exec inside the simulation */
+    if (!vfd_get(oldfd) && !pp())
+        /* no process context: not a plugin call — forward verbatim so
+         * the caller's O_CLOEXEC lands on the duplicate instead of
+         * being silently dropped by the dup2 funnel */
+        return get_real_dup3()(oldfd, newfd, flags);
+    /* plugin path: O_CLOEXEC itself is a no-op — no exec inside the
+     * simulation */
     return dup2(oldfd, newfd);
 }
 
@@ -1659,9 +1666,19 @@ int select(int nfds, fd_set* readfds, fd_set* writefds, fd_set* exceptfds,
     if (exceptfds) FD_ZERO(exceptfds);
     int count = 0;
     for (int i = 0; i < n_real; i++) {
-        if ((real_want[i] & 1) && readfds) FD_SET(real_fd[i], readfds);
-        if ((real_want[i] & 2) && writefds) FD_SET(real_fd[i], writefds);
-        count++;
+        /* count only if a set bit actually fires: a caller passing a
+         * NULL writefds with a write-interest shadow fd must not see a
+         * return > the number of bits set in its sets */
+        int hit = 0;
+        if ((real_want[i] & 1) && readfds) {
+            FD_SET(real_fd[i], readfds);
+            hit = 1;
+        }
+        if ((real_want[i] & 2) && writefds) {
+            FD_SET(real_fd[i], writefds);
+            hit = 1;
+        }
+        count += hit;
     }
     if (got <= 0) return count;
     for (int i = 0; i < n; i++) {

@@ -1292,7 +1292,7 @@ int shim_spawn(void* vrt, int host_gid, const char* so_path,
         p->argv_store.emplace_back(cursor);
         cursor += p->argv_store.back().size() + 1;
     }
-    for (auto& s : p->argv_store) p->argv.push_back(s.data());
+    for (auto& s : p->argv_store) p->argv.push_back(&s[0]);
     p->argv.push_back(nullptr);
 
     GThread* t0 = new_gthread(p); /* tid 0 = the plugin's main thread */

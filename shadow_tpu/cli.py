@@ -98,6 +98,14 @@ def make_parser() -> argparse.ArgumentParser:
                    help="ignored (pthread-era flag; kept for compatibility)")
     p.add_argument("--scheduler-policy", "-p", default=None,
                    help="ignored (pthread-era flag; kept for compatibility)")
+    p.add_argument("--fault", action="append", default=[],
+                   metavar="SPEC",
+                   help="append a fault to the schedule; repeatable. SPEC "
+                        "is 'TYPE key=value ...', e.g. "
+                        "'crash hosts=relay* start=30 end=45' or 'churn "
+                        "hosts=relay* start=10 end=60 period=20 downtime=5 "
+                        "frac=0.2' (same attrs as the config's <fault> "
+                        "element; see docs/6-Fault-Injection.md)")
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
                    help="write a checkpoint every N sim seconds (0=off)")
     p.add_argument("--checkpoint-path", default="shadow_tpu.ckpt.npz",
@@ -130,7 +138,7 @@ def _make_observability(cfg, sim, args):
             level_of[h.name] = h.spec.heartbeatloglevel
     tracker = Tracker(
         sim.names, logger, log_info=("node",), info_of=info_of,
-        level_of=level_of,
+        level_of=level_of, faults=sim.faults,
     )
     return logger, tracker
 
@@ -156,6 +164,18 @@ def main(argv=None) -> int:
         cfg = dataclasses.replace(cfg, stoptime=args.stoptime)
     if args.bootstrap_end is not None:
         cfg = dataclasses.replace(cfg, bootstraptime=args.bootstrap_end)
+    if args.fault:
+        # CLI faults append to the config's schedule BEFORE the config
+        # digest below: a fault schedule changes every event total, so a
+        # checkpoint must be tied to it like any other build input
+        from shadow_tpu.faults import parse_fault_dsl
+
+        cfg = dataclasses.replace(
+            cfg,
+            faults=cfg.faults + tuple(
+                parse_fault_dsl(s) for s in args.fault
+            ),
+        )
 
     # configs whose plugins are real shared objects run on the process
     # tier: native green threads + window-batched syscall exchange (the
@@ -364,6 +384,10 @@ def main(argv=None) -> int:
         "sim_s_per_wall_s": round(stop_s / max(wall, 1e-9), 3),
         "net_dropped": int(jax.device_get(stats.n_net_dropped.sum())),
         "queue_drops": int(jax.device_get(st.queues.drops.sum())),
+        "fault_dropped": int(jax.device_get(stats.n_fault_dropped.sum())),
+        "quarantined_events": int(
+            jax.device_get(stats.n_quarantined.sum())
+        ),
         # scheduler self-profiling (scheduler.c:266-271 analog)
         "sweeps": int(jax.device_get(stats.n_sweeps)),
         "cross_shard_packets": int(jax.device_get(stats.n_cross_shard)),

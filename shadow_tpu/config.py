@@ -85,6 +85,7 @@ class ShadowConfig:
     plugins: tuple[PluginSpec, ...] = ()
     hosts: tuple[HostSpec, ...] = ()
     base_dir: str = "."  # directory of the config file (path resolution)
+    faults: tuple = ()  # FaultSpec schedule (shadow_tpu.faults)
 
     def plugin_by_id(self, pid: str) -> PluginSpec | None:
         for p in self.plugins:
@@ -176,6 +177,7 @@ def parse_config(text_or_path: str, base_dir: str | None = None) -> ShadowConfig
 
     plugins: list[PluginSpec] = []
     hosts: list[HostSpec] = []
+    faults: list = []
     topo_path = ""
     topo_text = ""
 
@@ -190,6 +192,10 @@ def parse_config(text_or_path: str, base_dir: str | None = None) -> ShadowConfig
         elif el.tag == "kill":
             # legacy: <kill time="T"/> == stoptime attr
             stoptime = float(el.attrib["time"])
+        elif el.tag == "fault":
+            from shadow_tpu.faults import parse_fault_attrs
+
+            faults.append(parse_fault_attrs(el.attrib))
         elif el.tag in ("host", "node"):
             hosts.append(_parse_host(el))
 
@@ -205,6 +211,7 @@ def parse_config(text_or_path: str, base_dir: str | None = None) -> ShadowConfig
         plugins=tuple(plugins),
         hosts=tuple(hosts),
         base_dir=base_dir,
+        faults=tuple(faults),
     )
 
 

@@ -151,6 +151,11 @@ class Stats:
     n_inner_steps: jax.Array  # i64[] sequential frontier positions run
     n_xchg_rounds: jax.Array  # i64[] cross-shard all_to_all rounds
     n_cross_shard: jax.Array  # i64[] packets delivered across shards
+    # fault-injection attribution (every drop the chaos causes is
+    # accounted somewhere: either the packet died on the wire or the
+    # event died with its crashed host)
+    n_fault_dropped: jax.Array  # i64[H] packets lost to fault overlays
+    n_quarantined: jax.Array  # i64[H] events voided by host crashes
 
     @staticmethod
     def create(n_hosts: int, n_kinds: int = 1) -> "Stats":
@@ -159,7 +164,7 @@ class Stats:
         return Stats(
             z, z, z, s,
             jnp.zeros((n_hosts, n_kinds), jnp.int64),
-            s, s, s, s,
+            s, s, s, s, z, z,
         )
 
 
@@ -180,6 +185,10 @@ class EngineState:
     exec_cnt: jax.Array  # i32[H] per-host executed-event counters (RNG)
     stats: Stats
     cpu_free: jax.Array  # i64[H] virtual-CPU available-from time
+    # last fault-schedule epoch whose transitions (crash wipes, restart
+    # re-templating, bandwidth rescales) have been applied; always 0
+    # when no fault schedule is configured
+    fault_epoch: jax.Array  # i32[] (replicated)
 
 
 # Handler signature: (host_state_slice, ev: Events scalar, key) ->
@@ -299,7 +308,8 @@ class Engine:
     """
 
     def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network,
-                 cpu_cost=None, batch_handler=None):
+                 cpu_cost=None, batch_handler=None, faults=None,
+                 fault_reset=None):
         """`cpu_cost`: optional per-event virtual-CPU nanoseconds, indexed
         by GLOBAL host id (the reference's per-host CPU model delays
         event execution while the virtual CPU is busy — cpu.c:56-107,
@@ -329,7 +339,17 @@ class Engine:
         runs nothing this window, and each executed frontier advances
         cpu_free by the SUM of its events' costs — the batched analog of
         the reference's delay rounding (cpu.c:85-95 rounds accumulated
-        delay to a precision grid rather than modeling each instant)."""
+        delay to a precision grid rather than modeling each instant).
+
+        `faults`: optional CompiledFaults (shadow_tpu.faults). Baked
+        into the compiled step like `network` is — the schedule is
+        constants, only the `fault_epoch` watermark is state. Crashed
+        hosts stop executing (events quarantined), packets to/through
+        faulted links or dead destinations drop with attribution, and
+        epoch transitions wipe crashed hosts' queues and re-template
+        their state rows from `fault_reset` (a global-shaped hosts
+        pytree: the same initial SimHost the simulation was built with,
+        so a restarted host comes back with fresh listening sockets)."""
         self.cfg = cfg
         self.handlers = tuple(handlers)
         self.network = network
@@ -355,6 +375,18 @@ class Engine:
         # jitter rolls cost an extra uniform per emit row; skip them
         # entirely for jitter-free networks
         self._use_jitter = bool(getattr(network, "has_jitter", False))
+        # fault schedule: static sub-flags keep the no-fault (and
+        # partial-fault) compiled programs free of dead overlay work
+        self.faults = faults
+        self.fault_reset = fault_reset
+        self._f_crash = bool(faults is not None and faults.has_crash)
+        self._f_link = bool(faults is not None and faults.has_link)
+        self._f_bw = bool(faults is not None and faults.has_bw)
+        if (self._f_crash or self._f_bw) and fault_reset is None:
+            raise ValueError(
+                "faults with crashes or bandwidth changes need a "
+                "fault_reset template (the initial hosts pytree)"
+            )
 
     # -- collectives (identity when unsharded) ------------------------------
     def _gmin(self, x):
@@ -476,7 +508,29 @@ class Engine:
             exec_cnt=jnp.zeros((cfg.n_hosts,), jnp.int32),
             stats=Stats.create(cfg.n_hosts, len(self.handlers)),
             cpu_free=jnp.zeros((cfg.n_hosts,), jnp.int64),
+            fault_epoch=jnp.zeros((), jnp.int32),
         )
+
+    # -- fault-schedule helpers ---------------------------------------------
+    def _alive_slice(self, host0):
+        """[H, T] per-shard liveness table (bool), sliced from the global
+        [T, Hg] schedule. Constant per drain call; the per-event check is
+        then a one-hot select over the tiny epoch axis."""
+        f = self.faults
+        return jax.lax.dynamic_slice_in_dim(
+            f.alive, host0, self.cfg.n_hosts, axis=1
+        ).T
+
+    def _alive_at(self, al_sh, t):
+        """bool liveness per host at time(s) t. `al_sh` is _alive_slice's
+        [H, T]; t is [H] or [H, B] (leading host axis)."""
+        f = self.faults
+        e = f.epoch_of(t)  # [H] or [H, B]
+        tt = f.times.shape[0]
+        onehot = e[..., None] == jnp.arange(tt, dtype=jnp.int32)
+        if t.ndim == 1:
+            return jnp.any(onehot & al_sh, axis=-1)
+        return jnp.any(onehot & al_sh[:, None, :], axis=-1)
 
     # -- shared emit routing -------------------------------------------------
     def _route(self, emit: Emit, base_time, gids, window_end, rkeys, emask,
@@ -487,7 +541,16 @@ class Engine:
         worker.c:243-304; self-addressed sends traverse the topology
         self-loop like any other packet).
 
-        Returns (Events[N, K], final_mask, dropped, t, is_local)."""
+        The fault schedule (when compiled in) overlays the lookup: path
+        latency scales by the active epoch's [G, G] factor BEFORE the
+        barrier clamp (so even scaled-to-zero latency stays causal),
+        and an extra pass-probability roll — lane offset 2K, disjoint
+        from the reliability (0) and jitter (K) lanes — plus a
+        destination-liveness check at the ARRIVAL epoch drop packets
+        with their own attribution counter.
+
+        Returns (Events[N, K], final_mask, dropped, fdropped, t,
+        is_local)."""
         n, k = emit.dst.shape
         self_gid = gids[:, None]
         is_local = emit.local
@@ -501,6 +564,16 @@ class Engine:
             # one fused elementwise threefry pass over all [N, K] lanes
             return srng.uniform_lanes(rkeys, k, offset)
 
+        t = base_time[:, None] + dt
+        if self._f_link:
+            f = self.faults
+            hg = f.fgrp.shape[0]
+            dstc = jnp.clip(dst, 0, hg - 1)
+            gs = f.fgrp[jnp.broadcast_to(jnp.clip(self_gid, 0, hg - 1),
+                                         (n, k))]
+            gd = f.fgrp[dstc]
+            e_s = f.epoch_of(t)  # link state is read at SEND time
+            lat = lat * f.lat_milli[e_s, gs, gd] // 1000
         if self._use_jitter:
             # seeded symmetric latency noise, per packet (the reference
             # carries per-edge jitter attrs, topology.c:101-105; paths
@@ -512,13 +585,26 @@ class Engine:
                 ),
                 0,
             )
-        t = base_time[:, None] + dt
         t_remote = jnp.maximum(t + lat, window_end)
-        t = jnp.where(is_local, t, t_remote)
 
         u = rolls(0)
         dropped = (~is_local) & (u >= rel) & emask
-        final_mask = emask & ~dropped
+        fdropped = jnp.zeros_like(dropped)
+        if self._f_link:
+            u2 = rolls(2 * k)
+            fdropped = u2 >= f.passp[e_s, gs, gd]
+        if self._f_crash:
+            f = self.faults
+            hg = f.fgrp.shape[0]
+            dstc = jnp.clip(dst, 0, hg - 1)
+            # a packet addressed to a host that is dead when it ARRIVES
+            # is lost — the NIC it would land on does not exist
+            e_a = f.epoch_of(t_remote)
+            fdropped = fdropped | ~f.alive[e_a, dstc]
+        if self._f_link or self._f_crash:
+            fdropped = fdropped & (~is_local) & emask & ~dropped
+        t = jnp.where(is_local, t, t_remote)
+        final_mask = emask & ~dropped & ~fdropped
 
         out = Events(
             time=jnp.where(final_mask, t, TIME_INVALID),
@@ -528,7 +614,7 @@ class Engine:
             kind=emit.kind,
             args=emit.args,
         )
-        return out, final_mask, dropped, t, is_local
+        return out, final_mask, dropped, fdropped, t, is_local
 
     # -- execute one frontier position across all hosts ---------------------
     def _execute_step(self, hosts, src_seq, exec_cnt, stats, ev: Events,
@@ -566,7 +652,7 @@ class Engine:
         seq = src_seq[:, None] + within
         src_seq = src_seq + jnp.sum(inc, axis=1, dtype=jnp.int32)
 
-        out, final_mask, dropped, _t, _is_local = self._route(
+        out, final_mask, dropped, fdropped, _t, _is_local = self._route(
             emit, ev.time, gids, window_end, rkeys, emask, seq
         )
 
@@ -576,6 +662,8 @@ class Engine:
             n_executed=stats.n_executed + active,
             n_emitted=stats.n_emitted + jnp.sum(inc, axis=1, dtype=jnp.int64),
             n_net_dropped=stats.n_net_dropped + jnp.sum(dropped, axis=1, dtype=jnp.int64),
+            n_fault_dropped=stats.n_fault_dropped
+            + jnp.sum(fdropped, axis=1, dtype=jnp.int64),
             n_by_kind=stats.n_by_kind + (
                 jax.nn.one_hot(
                     jnp.clip(ev.kind, 0, len(self.handlers) - 1),
@@ -598,6 +686,7 @@ class Engine:
         b = max(1, min(cfg.drain_batch, c))
         gids = host0 + jnp.arange(h, dtype=jnp.int32)
         cpu_cost = self.cpu_cost[gids]  # [H, NK]
+        al_sh = self._alive_slice(host0) if self._f_crash else None
 
         def outer_cond(carry):
             q, cpu_free = carry[0], carry[5]
@@ -614,8 +703,17 @@ class Engine:
             bvalid = bt < window_end  # a prefix: rows are key-sorted
             if self._cpu_enabled:
                 bvalid = bvalid & (cpu_free[:, None] < window_end)
+            # crashed hosts consume (quarantine) their frontier without
+            # executing it: rows still clear below, handlers see
+            # TIME_INVALID
+            if self._f_crash:
+                run = bvalid & self._alive_at(
+                    al_sh, jnp.where(bvalid, bt, 0)
+                )
+            else:
+                run = bvalid
             evs = Events(
-                time=jnp.where(bvalid, bt, TIME_INVALID),
+                time=jnp.where(run, bt, TIME_INVALID),
                 dst=jnp.broadcast_to(gids[:, None], (h, b)),
                 src=q.src[:, :b],
                 seq=q.seq[:, :b],
@@ -631,9 +729,12 @@ class Engine:
             hk = hk.reshape((h, b, 2))
 
             hosts2, emit = jax.vmap(self.batch_handler)(hosts, evs, hk)
+            # n_exec counts the CLEARED prefix (and RNG positions) —
+            # quarantined events consume both; n_run counts executions
             n_exec = jnp.sum(bvalid, axis=1, dtype=jnp.int32)
-            hosts = _select_rows(n_exec > 0, hosts2, hosts)
-            emask = emit.mask & bvalid[:, :, None]
+            n_run = jnp.sum(run, axis=1, dtype=jnp.int32)
+            hosts = _select_rows(n_run > 0, hosts2, hosts)
+            emask = emit.mask & run[:, :, None]
 
             # dense per-source sequence numbers across the [B, K] lanes
             inc = emask.astype(jnp.int32).reshape(h, b * k)
@@ -643,7 +744,7 @@ class Engine:
 
             flat = lambda a: a.reshape((h * b,) + a.shape[2:])
             em_flat = jax.tree.map(flat, emit)
-            out, final_mask, dropped, _t, _loc = self._route(
+            out, final_mask, dropped, fdropped, _t, _loc = self._route(
                 em_flat,
                 evs.time.reshape(-1),
                 jnp.broadcast_to(gids[:, None], (h, b)).reshape(-1),
@@ -656,19 +757,25 @@ class Engine:
             exec_cnt = exec_cnt + n_exec
             stats2 = dataclasses.replace(
                 stats,
-                n_executed=stats.n_executed + n_exec,
+                n_executed=stats.n_executed + n_run,
                 n_emitted=stats.n_emitted
                 + jnp.sum(inc, axis=1, dtype=jnp.int64),
                 n_net_dropped=stats.n_net_dropped
                 + jnp.sum(
                     dropped.reshape(h, b * k), axis=1, dtype=jnp.int64
                 ),
+                n_fault_dropped=stats.n_fault_dropped
+                + jnp.sum(
+                    fdropped.reshape(h, b * k), axis=1, dtype=jnp.int64
+                ),
+                n_quarantined=stats.n_quarantined
+                + jnp.sum(bvalid & ~run, axis=1, dtype=jnp.int64),
                 n_by_kind=stats.n_by_kind + jnp.sum(
                     jax.nn.one_hot(
                         jnp.clip(evs.kind, 0, len(self.handlers) - 1),
                         len(self.handlers), dtype=jnp.int64,
                     )
-                    * bvalid[:, :, None],
+                    * run[:, :, None],
                     axis=1,
                 ),
             )
@@ -679,9 +786,9 @@ class Engine:
                 # gather here measured ~20% of the whole sweep on TPU
                 ev_cost = _kind_cost(cpu_cost, evs.kind)
                 total_cost = jnp.sum(
-                    jnp.where(bvalid, ev_cost, 0), axis=1
+                    jnp.where(run, ev_cost, 0), axis=1
                 )
-                t_last = jnp.max(jnp.where(bvalid, bt, 0), axis=1)
+                t_last = jnp.max(jnp.where(run, bt, 0), axis=1)
                 cpu_free = jnp.where(
                     total_cost > 0,
                     jnp.maximum(cpu_free, t_last) + total_cost,
@@ -915,6 +1022,7 @@ class Engine:
         sw = max(cfg.eff_stage_width, b + k)
         gids = host0 + jnp.arange(h, dtype=jnp.int32)
         cpu_cost = self.cpu_cost[gids]  # [H, NK] this shard's costs
+        al_sh = self._alive_slice(host0) if self._f_crash else None
 
         def outer_cond(carry):
             q, cpu_free = carry[0], carry[5]
@@ -1015,6 +1123,20 @@ class Engine:
                     & precede_q(ev_t, mss)
                     & (cnt + k <= sw)  # high-water: leftovers flush
                 )
+                # a crashed host consumes its due events without running
+                # them (quarantine): the slot still clears below — via
+                # `active` — so the drain makes progress, but the handler
+                # never fires and no emits escape the dead host
+                if self._f_crash:
+                    alv = self._alive_at(al_sh, eff_t)
+                    runm = active & alv
+                    stats = dataclasses.replace(
+                        stats,
+                        n_quarantined=stats.n_quarantined
+                        + (active & ~alv),
+                    )
+                else:
+                    runm = active
                 stage = dataclasses.replace(
                     stage,
                     time=jnp.where(
@@ -1023,12 +1145,12 @@ class Engine:
                 )
                 ev = dataclasses.replace(
                     ev,
-                    time=jnp.where(active, eff_t, TIME_INVALID),
+                    time=jnp.where(runm, eff_t, TIME_INVALID),
                     dst=gids,
                 )
                 hosts, src_seq, exec_cnt, stats, out, _fmask = (
                     self._execute_step(
-                        hosts, src_seq, exec_cnt, stats, ev, active,
+                        hosts, src_seq, exec_cnt, stats, ev, runm,
                         window_end, gids,
                     )
                 )
@@ -1049,7 +1171,7 @@ class Engine:
                             ev.kind == bkind, nseg.astype(ev_cost.dtype), 1
                         )
                     cpu_free = jnp.where(
-                        active & (ev_cost > 0), eff_t + ev_cost,
+                        runm & (ev_cost > 0), eff_t + ev_cost,
                         cpu_free,
                     )
                 stage = self._stage_append(stage, out)
@@ -1159,9 +1281,81 @@ class Engine:
             nxt = jnp.maximum(nxt, st.cpu_free)
         return self._gmin(jnp.min(nxt))
 
+    def _apply_fault_epoch(self, st: EngineState, nxt, host0) -> EngineState:
+        """Apply fault-schedule transitions entered since the last window.
+
+        Window starts are globally synchronized (pmin barrier), so every
+        shard applies the same transitions at the same sim time — the
+        epoch watermark keeps this exact across checkpoint/restore too.
+        For hosts dead at any newly-entered epoch: wipe their queues
+        (counted as quarantined — a crash voids pending work) and
+        re-template their state rows from `fault_reset`, which is what a
+        restart is — fresh listening sockets, zeroed app state, while
+        `src_seq`/`exec_cnt` stay monotone so (src, seq) uniqueness and
+        RNG streams survive the reboot. Bandwidth epochs rescale NIC
+        rates from the template's configured values. Runs under lax.cond:
+        a window with no epoch change pays one scalar compare."""
+        f = self.faults
+        h = self.cfg.n_hosts
+        tt = f.times.shape[0]
+        e = f.epoch_of(nxt)
+
+        def apply(st):
+            idx = jnp.arange(tt, dtype=jnp.int32)
+            gap = (idx > st.fault_epoch) & (idx <= e)
+            tmpl = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, host0, h, axis=0),
+                self.fault_reset,
+            )
+            hosts, q, stats = st.hosts, st.queues, st.stats
+            if self._f_crash:
+                al_sh = jax.lax.dynamic_slice_in_dim(
+                    f.alive, host0, h, axis=1
+                )
+                reset = jnp.any(gap[:, None] & ~al_sh, axis=0)  # [H]
+                wiped = jnp.sum(
+                    reset[:, None] & (q.time != TIME_INVALID),
+                    axis=1, dtype=jnp.int64,
+                )
+                q = dataclasses.replace(
+                    q, time=jnp.where(reset[:, None], TIME_INVALID, q.time)
+                )
+                hosts = _select_rows(reset, tmpl, hosts)
+                stats = dataclasses.replace(
+                    stats, n_quarantined=stats.n_quarantined + wiped
+                )
+            if self._f_bw:
+                bw_t = jax.lax.dynamic_slice_in_dim(
+                    f.bw_scale, host0, h, axis=1
+                )  # [T, H]
+                bw_e = jnp.sum(
+                    jnp.where((idx == e)[:, None], bw_t, 0.0), axis=0
+                )
+                net = hosts.net
+                hosts = dataclasses.replace(
+                    hosts,
+                    net=dataclasses.replace(
+                        net,
+                        nic_tx=dataclasses.replace(
+                            net.nic_tx, rate=tmpl.net.nic_tx.rate * bw_e
+                        ),
+                        nic_rx=dataclasses.replace(
+                            net.nic_rx, rate=tmpl.net.nic_rx.rate * bw_e
+                        ),
+                    ),
+                )
+            return dataclasses.replace(
+                st, queues=q, hosts=hosts, stats=stats,
+                fault_epoch=e.astype(jnp.int32),
+            )
+
+        return jax.lax.cond(e != st.fault_epoch, apply, lambda s: s, st)
+
     def _advance(self, st: EngineState, nxt, stop, host0) -> EngineState:
         """Open the window [nxt, min(nxt+lookahead, stop)) and drain it."""
         window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
+        if self._f_crash or self._f_bw:
+            st = self._apply_fault_epoch(st, nxt, host0)
         st = self._drain_window(st, window_end, host0)
         return dataclasses.replace(st, now=window_end)
 

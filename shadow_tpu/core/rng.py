@@ -37,6 +37,7 @@ _DOM_LANE = 0x4C414E45  # "LANE": per-lane rolls
 _DOM_FOLD = 0x464F4C44  # "FOLD": fold_in derivations
 _DOM_UNIF = 0x554E4946  # "UNIF": uniform/bernoulli draws
 _DOM_RINT = 0x52494E54  # "RINT": randint draws
+_DOM_FAULT = 0x464C5453  # "FLTS": named fault-schedule streams
 
 
 def _rotl(x: jax.Array, r: int) -> jax.Array:
@@ -130,6 +131,25 @@ def uniform_lanes(key: jax.Array, n_lanes: int, offset: int = 0) -> jax.Array:
     x0, _ = threefry2x32(
         key[..., 0:1], key[..., 1:2], lanes, jnp.uint32(_DOM_LANE)
     )
+    return _to_unit(x0)
+
+
+def fault_stream_uniform(seed: int, stream: int, n: int) -> jax.Array:
+    """f32[n] uniforms from the named fault-schedule stream.
+
+    Derived from (root seed, stream index, element index) only — never
+    from host sharding or execution counters — so a fault timeline built
+    from these draws is identical across shard counts and across
+    checkpoint/restore (the schedule is recompiled from the same config;
+    faults/schedule.py consumes this at build time, host-side).
+    """
+    base = root_key(seed)
+    k = _key(*threefry2x32(base[..., 0], base[..., 1],
+                           jnp.uint32(stream & 0xFFFFFFFF),
+                           jnp.uint32(_DOM_FAULT)))
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    x0, _ = threefry2x32(k[..., 0:1], k[..., 1:2], idx,
+                         jnp.uint32(_DOM_FAULT))
     return _to_unit(x0)
 
 

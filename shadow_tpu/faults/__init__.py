@@ -1,0 +1,23 @@
+"""Deterministic fault-injection & churn subsystem.
+
+See schedule.py for the compiler and docs/6-Fault-Injection.md for the
+schedule format and determinism guarantees.
+"""
+
+from shadow_tpu.faults.schedule import (
+    FAULT_TYPES,
+    CompiledFaults,
+    FaultSpec,
+    compile_faults,
+    parse_fault_attrs,
+    parse_fault_dsl,
+)
+
+__all__ = [
+    "FAULT_TYPES",
+    "CompiledFaults",
+    "FaultSpec",
+    "compile_faults",
+    "parse_fault_attrs",
+    "parse_fault_dsl",
+]

@@ -28,6 +28,18 @@ HOSTS_AXIS = "hosts"
 DCN_AXIS = "dcn"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` across jax versions: older releases only expose
+    `jax.experimental.shard_map.shard_map`, whose replication-check knob
+    is spelled `check_rep` rather than `check_vma`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(n_devices: int | None = None, axis: str = HOSTS_AXIS,
               dcn_slices: int = 1) -> Mesh:
     devs = jax.devices()
@@ -70,6 +82,56 @@ def state_specs(st, n_hosts_local: int, axis: str = HOSTS_AXIS):
     return jax.tree.map(spec, st)
 
 
+def pmap_call(fn, mesh: Mesh, specs, per: int, axes):
+    """Run `fn(state, stop, host0)` data-parallel via `jax.pmap`.
+
+    Fallback for jax versions without `jax.shard_map`: their experimental
+    shard_map miscompiles this engine under check_rep=False (collectives
+    inside while/cond conds leak device 0's carried state to every shard
+    — observed as hosts on shard > 0 recording wrong peer gids), while
+    the mature pmap path compiles the identical program correctly.
+
+    `specs` is the state's PartitionSpec pytree: leaves sharded on the
+    host axis reshape [S*per, ...] <-> [S, per, ...] around the pmap;
+    replicated leaves broadcast in and take device 0's copy out (the
+    same contract shard_map's P() out_spec has).
+    """
+    if not isinstance(axes, str):
+        raise NotImplementedError(
+            "multi-slice meshes need jax.shard_map (jax >= 0.4.38)"
+        )
+    n = int(np.prod(mesh.devices.shape))
+    mask = jax.tree.map(lambda sp: len(sp) > 0, specs)
+    in_axes = jax.tree.map(lambda m: 0 if m else None, mask)
+
+    def split(st):
+        return jax.tree.map(
+            lambda x, m: x.reshape((n, per) + x.shape[1:]) if m else x,
+            st, mask,
+        )
+
+    def join(st):
+        return jax.tree.map(
+            lambda x, m: x.reshape((n * per,) + x.shape[2:]) if m else x,
+            st, mask,
+        )
+
+    pf = jax.pmap(
+        lambda st, stop: fn(
+            st, stop, jax.lax.axis_index(axes).astype(jnp.int32) * per
+        ),
+        axis_name=axes,
+        in_axes=(in_axes, None),
+        out_axes=in_axes,
+        devices=list(mesh.devices.flatten()),
+    )
+
+    def call(st, stop):
+        return join(pf(split(st), stop))
+
+    return call
+
+
 def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int, axis: str = HOSTS_AXIS):
     """Wrap an axis-aware Engine into sharded init/run/step callables.
 
@@ -85,7 +147,7 @@ def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int, axis: str = HOST
     specs = state_specs(template, n_hosts_local, axis)
 
     init = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda: init_fn(_host0()),
             mesh=mesh,
             in_specs=(),
@@ -95,8 +157,10 @@ def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int, axis: str = HOST
     )
 
     def _wrap(fn):
+        if not hasattr(jax, "shard_map"):
+            return pmap_call(fn, mesh, specs, n_hosts_local, axis)
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda s, t: fn(s, t, _host0()),
                 mesh=mesh,
                 in_specs=(specs, P()),

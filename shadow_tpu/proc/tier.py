@@ -75,6 +75,12 @@ from shadow_tpu.sim import build_simulation
 from shadow_tpu.transport.stack import N_PKT_ARGS
 from shadow_tpu.transport.tcp import CLOSED, ESTABLISHED
 
+# how long a closed UDP endpoint's source-attribution zombie may outlive
+# its last unaccounted datagram: covers datagrams the network dropped
+# outright (they never reach a ring, so the seq-set drain can't retire
+# them) — far beyond any path latency the topology can express
+_UDP_ZOMBIE_TTL_NS = 30 * SECOND
+
 
 class ProcessTier:
     """Drives native plugin processes against a config-built simulation.
@@ -152,6 +158,9 @@ class ProcessTier:
         # per-process stoptime heap ((stop_ns, pid); the reference stops
         # each plugin individually, configuration.h:38-102 + process_stop)
         self._stops: list[tuple[int, int]] = []
+        # per-host process specs, kept for fault restarts: a host coming
+        # back up respawns these with fresh state (new pids, empty fds)
+        self._proc_spec: dict[int, list[tuple]] = {}
         # locality may have renumbered gids; map hosts by NAME
         gid_of = {name: g for g, name in enumerate(self.sim.names)}
         for h in expand_hosts(cfg):
@@ -175,6 +184,10 @@ class ProcessTier:
                     heapq.heappush(
                         self._stops, (int(p.stoptime * SECOND), pid)
                     )
+                self._proc_spec.setdefault(gid, []).append((
+                    path, argv, h.name, int(p.starttime * SECOND),
+                    int(p.stoptime * SECOND) if p.stoptime else None,
+                ))
 
         # UDP endpoint bookkeeping (udp.c:26-60 association realized as
         # driver maps): (pid, fd) -> (gid, slot, port) for runtime
@@ -195,8 +208,15 @@ class ProcessTier:
                * self.sim.engine.cfg.n_shards)
         self._prev_udp_cnt = np.zeros((h_n,), np.int32)
         # (gid, port) -> (pid, fd) for EXITED senders whose in-flight
-        # datagrams still need payload attribution at the ring drain
+        # datagrams still need payload attribution at the ring drain.
+        # _udp_outstanding holds each source endpoint's sent-but-not-yet-
+        # drained datagram seqs: a zombie is pruned the moment its set
+        # empties (the drain cursor passed its last in-flight datagram),
+        # with _udp_zombie_deadline as the TTL backstop for datagrams the
+        # network dropped (those never reach any ring)
         self._udp_src_zombies: dict[tuple[int, int], tuple[int, int]] = {}
+        self._udp_outstanding: dict[tuple[int, int], set[int]] = {}
+        self._udp_zombie_deadline: dict[tuple[int, int], int] = {}
         self._prev_rx = np.zeros((h_n, n_sockets), np.int64)
         self._prev_fin = np.zeros((h_n, n_sockets), bool)
         # vectorized-observe state: endpoint membership, per-slot owed
@@ -225,17 +245,26 @@ class ProcessTier:
             )
         return s
 
-    def _close_udp_ep(self, key, rows) -> None:
-        """Tear down one UDP endpoint (exit/close/stoptime-kill share
-        this): free the driver slot, clear the DESTINATION demux row —
-        arrivals addressed to it now drop, kernel semantics — but keep
-        SOURCE attribution for datagrams already sent: the ring drain
-        needs (pid, fd) to locate the payload stash (the runtime keeps
-        fds entries until shim_free), and dropping it lost a server's
-        final reply when it echoed then returned from main()."""
+    def _close_udp_ep(self, key, rows, now: int) -> None:
+        """Tear down one UDP endpoint (exit/close/stoptime-kill/crash
+        share this): free the driver slot, clear the DESTINATION demux
+        row — arrivals addressed to it now drop, kernel semantics — but
+        keep SOURCE attribution for datagrams already sent: the ring
+        drain needs (pid, fd) to locate the payload stash (the runtime
+        keeps fds entries until shim_free), and dropping it lost a
+        server's final reply when it echoed then returned from main().
+        A zombie is only created while datagrams are actually
+        outstanding, and the ring drain prunes it the moment its last
+        one is accounted for — churny UDP workloads no longer grow this
+        map without bound."""
         gid, slot, port = self.udp_eps.pop(key)
         self.udp_port.pop((gid, port), None)
-        self._udp_src_zombies[(gid, port)] = key
+        src_key = (gid, port)
+        if self._udp_outstanding.get(src_key):
+            self._udp_src_zombies[src_key] = key
+            self._udp_zombie_deadline[src_key] = now + _UDP_ZOMBIE_TTL_NS
+        else:
+            self._udp_outstanding.pop(src_key, None)
         self._free_slots.setdefault(gid, []).append(slot)
         rows.append((gid, [CMD_UDP_CLOSE, slot]))
 
@@ -291,6 +320,50 @@ class ProcessTier:
             self._driver_owned.discard(key)
             if recycle:
                 self._free_slots.setdefault(gid, []).append(slot)
+
+    # ------------------------------------------------------------- faults
+    def _fault_down(self, gid: int, rows, now: int) -> None:
+        """A scheduled crash took the host down: kill its native
+        processes and drop the driver's endpoint bookkeeping outright.
+        The device side wipes the host's queue and re-templates its rows
+        at the fault epoch, so there is no close handshake to observe —
+        surviving peers tear down through the real retransmit/RST paths
+        instead, exactly as against a real dead box."""
+        for pid, g in list(self.pid_host.items()):
+            if g != gid or pid in self.exit_codes:
+                continue
+            self.rt.kill(pid, 0)
+            self.exit_codes[pid] = 0
+            for key in [k for k in self._timer_gen if k[0] == pid]:
+                self._timer_gen[key] += 1
+            self._wakes = [w for w in self._wakes if w[1] != pid]
+            heapq.heapify(self._wakes)
+            # a process the crash beat to its starttime never boots this
+            # incarnation (it comes back with the host, if it restarts)
+            self._starts = [s for s in self._starts if s[1] != pid]
+            heapq.heapify(self._starts)
+        for key in [k for k in list(self.ep_of) if k[0] == gid]:
+            self._drop_ep(*key, recycle=True)
+        for gp in [k for k in self.listen_ep if k[0] == gid]:
+            ep = self.listen_ep.pop(gp)
+            self._listen_of_ep.pop(ep, None)
+        for key in [k for k, v in self.udp_eps.items() if v[0] == gid]:
+            self._close_udp_ep(key, rows, now)
+
+    def _fault_up(self, gid: int, now: int) -> None:
+        """The host rebooted: respawn its configured processes with
+        fresh state — new pids, empty fd tables, starttime re-applied
+        relative to boot (the operator-restarts-the-daemon analog)."""
+        for path, argv, name, start_ns, stop_ns in self._proc_spec.get(
+                gid, ()):
+            if stop_ns is not None and stop_ns <= now:
+                continue  # its configured lifetime already ended
+            pid = self.rt.spawn(gid, path, argv)
+            self.rt.set_host_name(pid, name)
+            self.pid_host[pid] = gid
+            heapq.heappush(self._starts, (max(start_ns, now), pid))
+            if stop_ns is not None:
+                heapq.heappush(self._stops, (stop_ns, pid))
 
     def _wire_try_pair(self, gid: int, slot: int, lport: int,
                        peer_gid: int, pport: int) -> None:
@@ -374,6 +447,8 @@ class ProcessTier:
                 # without this, the drain could attribute the NEW
                 # process's in-flight datagrams to the old one's stash
                 self._udp_src_zombies.pop((gid, int(r.port)), None)
+                self._udp_outstanding.pop((gid, int(r.port)), None)
+                self._udp_zombie_deadline.pop((gid, int(r.port)), None)
                 rows.append((gid, [CMD_UDP_BIND, slot, int(r.port)]))
             elif r.op == REQ_SENDTO:
                 ep = self.udp_eps.get((pid, fd))
@@ -392,6 +467,8 @@ class ProcessTier:
                     dst_gid = addr.host_id
                 rows.append((gid, [CMD_SENDTO, ep[1], dst_gid,
                                    int(r.port), nbytes, seq]))
+                self._udp_outstanding.setdefault(
+                    (gid, ep[2]), set()).add(seq)
             elif r.op == REQ_CLOSE:
                 key = (pid, fd)
                 if key in self._listen_of_ep:
@@ -413,7 +490,7 @@ class ProcessTier:
                         # OWN turnover and be torn down by observe
                         self._prev_gen[gid, slot] += 1
                 elif key in self.udp_eps:
-                    self._close_udp_ep(key, rows)
+                    self._close_udp_ep(key, rows, now)
                 elif key in self.slot_of:
                     gid, slot = self.slot_of[key]
                     rows.append((gid, [CMD_CLOSE, slot]))
@@ -440,7 +517,7 @@ class ProcessTier:
                     if p_pid == pid:
                         rows.append((gid, [CMD_CLOSE, slot]))
                 for key in [k for k in self.udp_eps if k[0] == pid]:
-                    self._close_udp_ep(key, rows)
+                    self._close_udp_ep(key, rows, now)
         return rows
 
     # ------------------------------------------------------------- inject
@@ -523,6 +600,16 @@ class ProcessTier:
                     src_key = (int(usrc[g, k]), int(usport[g, k]))
                     src_ep = (self.udp_port.get(src_key)
                               or self._udp_src_zombies.get(src_key))
+                    out = self._udp_outstanding.get(src_key)
+                    if out is not None:
+                        out.discard(int(useq[g, k]))
+                        if not out and src_key in self._udp_src_zombies:
+                            # the drain cursor just passed the zombie's
+                            # last in-flight datagram: nothing can
+                            # attribute to it anymore
+                            del self._udp_src_zombies[src_key]
+                            del self._udp_outstanding[src_key]
+                            self._udp_zombie_deadline.pop(src_key, None)
                     if dst_ep is None or src_ep is None:
                         continue  # endpoint closed while in flight
                     self.rt.udp_deliver(
@@ -623,13 +710,27 @@ class ProcessTier:
         stop_ns = int(stop_s * SECOND) if stop_s is not None else sim.stop_ns
         st = sim.state0
         now = 0
+        # host-side mirror of the fault schedule's liveness flips: a
+        # down-flip kills the host's native processes, an up-flip
+        # reboots them (the device applies the matching queue wipe and
+        # state re-template at the same epoch inside the jitted loop)
+        flips = (sim.faults.transitions_in(-1, stop_ns)
+                 if sim.faults is not None else [])
+        fcur = 0
         while True:
             comps = self._pending_comps
             self._pending_comps = []
+            stop_rows = []
+            while fcur < len(flips) and flips[fcur][0] <= now:
+                _, fgid, up = flips[fcur]
+                fcur += 1
+                if up:
+                    self._fault_up(fgid, now)
+                else:
+                    self._fault_down(fgid, stop_rows, now)
             while self._starts and self._starts[0][0] <= now:
                 _, pid = heapq.heappop(self._starts)
                 self.rt.start(pid)
-            stop_rows = []
             while self._stops and self._stops[0][0] <= now:
                 _, pid = heapq.heappop(self._stops)
                 if pid in self.exit_codes:
@@ -654,7 +755,7 @@ class ProcessTier:
                 # and its datagram sockets (no handshake to run down:
                 # free the slot and clear the demux row immediately)
                 for key in [k for k in self.udp_eps if k[0] == pid]:
-                    self._close_udp_ep(key, stop_rows)
+                    self._close_udp_ep(key, stop_rows, now)
             if stop_rows:
                 st = self._inject(st, stop_rows, now)
             while self._wakes and self._wakes[0][0] <= now:
@@ -690,6 +791,7 @@ class ProcessTier:
                 and not self._starts
                 and not self.slot_of
                 and not self.udp_eps
+                and fcur >= len(flips)  # a restart could revive hosts
             ):
                 break
             # never step past the next host-side interest point
@@ -708,9 +810,20 @@ class ProcessTier:
                 heapq.heappop(self._timers)
             if self._timers:
                 bound = min(bound, max(self._timers[0][0], now + 1))
+            # land the window edge on the next liveness flip so the
+            # device's epoch switch and the driver's kill/respawn agree
+            # on when the crash happened
+            if fcur < len(flips):
+                bound = min(bound, max(flips[fcur][0], now + 1))
             st = sim.step_window(st, bound)
             now = int(jax.device_get(st.now))
             self._observe(st)
+            if self._udp_zombie_deadline:
+                for zk in [k for k, d in self._udp_zombie_deadline.items()
+                           if d <= now]:
+                    del self._udp_zombie_deadline[zk]
+                    self._udp_src_zombies.pop(zk, None)
+                    self._udp_outstanding.pop(zk, None)
         drops = int(jax.device_get(st.queues.drops.sum()))
         if drops and self.strict_overflow:
             raise RuntimeError(

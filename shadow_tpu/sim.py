@@ -137,6 +137,7 @@ class Simulation:
     pcap_gids: tuple = ()  # hosts with logpcap set
     pcap_dir: str = "shadow.pcap.d"  # from the pcapdir host attr
     kind_names: tuple = ()  # handler-kind names (object-counter labels)
+    faults: Any = None  # CompiledFaults when the config schedules any
 
     _jit_run: Any = None
     _jit_step: Any = None
@@ -147,7 +148,9 @@ class Simulation:
             return jax.jit(lambda st, stop: fn(st, stop, 0))
         from jax.sharding import PartitionSpec as P
 
-        from shadow_tpu.parallel.mesh import hosts_axes, state_specs
+        from shadow_tpu.parallel.mesh import (
+            hosts_axes, shard_map, state_specs,
+        )
 
         axes = hosts_axes(self.mesh)
         per = self.engine.cfg.n_hosts
@@ -157,12 +160,17 @@ class Simulation:
             self.state0, per * self.engine.cfg.n_shards, axes
         )
 
+        if not hasattr(jax, "shard_map"):
+            from shadow_tpu.parallel.mesh import pmap_call
+
+            return pmap_call(fn, self.mesh, specs, per, axes)
+
         def sharded(st, stop):
             host0 = jax.lax.axis_index(axes).astype(jnp.int32) * per
             return fn(st, stop, host0)
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 sharded,
                 mesh=self.mesh,
                 in_specs=(specs, P()),
@@ -599,6 +607,14 @@ def build_simulation(
     if qdisc not in ("fifo", "rr"):
         raise ValueError(f"unknown qdisc {qdisc!r}")
     tcp_kw = dict(tx_burst=1, inline_budget=1) if qdisc == "rr" else {}
+    # a restarted host has lost all connection state, so survivors'
+    # segments to it must draw an RST (the kernel's answer to a segment
+    # for no socket) rather than blackholing until RTO exhaustion
+    have_crash_faults = any(
+        f.type in ("crash", "churn") for f in cfg.faults
+    )
+    if have_crash_faults:
+        tcp_kw["rst_on_unmatched"] = True
     tcp = (
         TCP(auto_close=False, cc=tcp_cc, in_order=tcp_in_order,
             child_slot_limit=tcp_child_slot_limit, **tcp_kw)
@@ -737,6 +753,20 @@ def build_simulation(
     # segments coarsens by at most one window; loss fidelity is exact
     # (reliability rolls happened at send time).
     burst = None
+    if burst_rx and fuse_rx and tcp is not None and pcap_mask.any():
+        # burst folding collapses contiguous same-flow arrivals into one
+        # multi-segment event, so the capture ring would record one
+        # merged frame where the reference writes N — silently coarser
+        # pcaps. Capture fidelity wins over drain depth.
+        import warnings
+
+        warnings.warn(
+            "burst_rx disabled: pcap capture is enabled and burst "
+            "folding would merge captured segments (pass burst_rx=False "
+            "to silence)",
+            stacklevel=2,
+        )
+        burst_rx = False
     if burst_rx and fuse_rx and tcp is not None:
         from shadow_tpu.transport.stack import (
             A_ACK, A_AUX, A_DPORT, A_LEN, A_META, A_SACK0, A_SACK1,
@@ -797,9 +827,24 @@ def build_simulation(
                 cost_arg if cost_arg.ndim == 2 else cpu_cost[:, None]
             )
             cost_arg = base + extra_ns
+    hosts_state = SimHost(net=net, app=app_state)
+
+    faults = None
+    if cfg.faults:
+        from shadow_tpu.faults import compile_faults
+
+        name_by_gid = [""] * n_hosts
+        for h in hosts:
+            name_by_gid[h.gid] = h.name
+        faults = compile_faults(cfg.faults, name_by_gid, n_hosts, seed)
     eng = Engine(
         ecfg, handlers, network,
         cpu_cost=jnp.asarray(cost_arg) if cost_arg.any() else None,
+        faults=faults,
+        # the initial hosts pytree doubles as the restart template: a
+        # crashed-and-restarted host comes back with boot-fresh state
+        # (listen sockets rebound, app state re-zeroed)
+        fault_reset=hosts_state if faults is not None else None,
     )
 
     # -- initial events: process starts (slave.c:296-336 scheduling of
@@ -828,7 +873,6 @@ def build_simulation(
         kind=jnp.asarray(kinds), args=jnp.asarray(argw),
     )
 
-    hosts_state = SimHost(net=net, app=app_state)
     if mesh is None:
         st0 = eng.init_state(hosts_state, init)
     else:
@@ -837,7 +881,9 @@ def build_simulation(
         # ignores out-of-shard destinations)
         from jax.sharding import PartitionSpec as P
 
-        from shadow_tpu.parallel.mesh import hosts_axes, state_specs
+        from shadow_tpu.parallel.mesh import (
+            hosts_axes, shard_map, state_specs,
+        )
 
         axes = hosts_axes(mesh)
         hspecs = jax.tree.map(lambda _: P(axes), hosts_state)
@@ -857,7 +903,7 @@ def build_simulation(
         )
         ospecs = state_specs(template, per_shard, axes)
         st0 = jax.jit(
-            jax.shard_map(
+            shard_map(
                 init_shard,
                 mesh=mesh,
                 in_specs=(hspecs,),
@@ -877,6 +923,7 @@ def build_simulation(
         pcap_gids=tuple(int(g) for g in np.nonzero(pcap_mask)[0]),
         pcap_dir=(pcap_dirs.pop() if pcap_dirs else "shadow.pcap.d"),
         kind_names=tuple(kind_names),
+        faults=faults,
     )
 
 

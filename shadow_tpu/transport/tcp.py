@@ -678,6 +678,14 @@ class TCP:
       the reference.
     in_order: app deliveries surface bytes only as rcv_nxt advances
       (strict byte-stream order) instead of on arrival.
+    rst_on_unmatched: a TCP segment that demuxes to no socket (or lands
+      non-SYN on a bare listener) draws an RST, the kernel's answer to a
+      segment for a connection it doesn't know. Off by default — the
+      bundled drivers close via FIN and never strand segments — but
+      sim.py enables it when the fault schedule can crash hosts, so
+      survivors' retransmits toward a crash-restarted peer (whose
+      connection state the reboot wiped) tear down through the real RST
+      path instead of blackholing until RTO exhaustion.
 
     Engine `max_emit` must be >= `min_max_emit(app_rows)` where app_rows is
     the number of Emit rows the installed on_recv callback returns.
@@ -686,7 +694,8 @@ class TCP:
     def __init__(self, tx_burst: int = 4, inline_budget: int = 2,
                  auto_close: bool = True, cc="reno", delack: bool = True,
                  in_order: bool = False, autotune: bool = True,
-                 child_slot_limit: int | None = None):
+                 child_slot_limit: int | None = None,
+                 rst_on_unmatched: bool = False):
         self.tx_burst = tx_burst
         self.inline_budget = inline_budget
         self.auto_close = auto_close
@@ -694,6 +703,7 @@ class TCP:
         self.delack = delack
         self.in_order = in_order
         self.autotune = autotune
+        self.rst_on_unmatched = rst_on_unmatched
         # passive-open children only allocate slots < limit, reserving
         # the top of the table for driver/app-owned sockets (the process
         # tier's split — without it a recycled driver slot could be
@@ -1361,11 +1371,28 @@ class TCP:
         )
 
         # -- control/ACK row: SYN-ACK (passive open / dup SYN), the
-        # handshake-completing pure ACK, or a data/dup ACK
+        # handshake-completing pure ACK, a data/dup ACK — or an RST for a
+        # segment no socket claims. The RST shares the ctl emit lane: an
+        # unmatched segment triggers none of the other ctl conditions
+        # (is_tcp needs a slot; a stray at LISTEN has no has_seg/ack_ok).
+        if self.rst_on_unmatched:
+            need_rst = (
+                (pkt.proto == PROTO_TCP)
+                & ((pkt.flags & F_RST) == 0)
+                & (
+                    (slot < 0)
+                    | (is_tcp & ~f_syn & (row.state == LISTEN))
+                )
+            )
+        else:
+            need_rst = jnp.asarray(False)
         need_synack = do_open | dup_syn
-        need_ctl = need_synack | est_active | send_ack
-        ctl_flags = jnp.where(need_synack, F_SYN | F_ACK, F_ACK)
-        ctl_ack = jnp.where(need_synack, 0, row.rcv_nxt)
+        need_ctl = need_synack | est_active | send_ack | need_rst
+        ctl_flags = jnp.where(
+            need_synack, F_SYN | F_ACK,
+            jnp.where(need_rst, F_RST | F_ACK, F_ACK),
+        )
+        ctl_ack = jnp.where(need_synack | need_rst, 0, row.rcv_nxt)
         # echo the arriving segment's ts for the peer's RTT estimator; the
         # SYN-ACK echoes the SYN's ts the same way
         ctl_aux = pkt.aux

@@ -28,7 +28,7 @@ import numpy as np
 # v2: event-queue rows carry a sorted-by-(time,src,seq) invariant (empties
 # last) that the engine's frontier reads rely on; v1 checkpoints (arbitrary
 # slot order) would silently execute events out of order if loaded.
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3  # v3: EngineState.fault_epoch + fault Stats counters
 
 
 def _leaf_paths(tree: Any) -> list[str]:

@@ -37,6 +37,13 @@ RAM_HEADER = (
     "queue-slots-used,queue-capacity,sockets-used,sockets-capacity,"
     "state-bytes"
 )
+# fault attribution (only emitted when the run has a fault schedule):
+# packets lost to fault overlays, events voided by crashes, and seconds
+# of scheduled downtime — so runs report what the chaos did
+FAULT_HEADER = (
+    "[shadow-heartbeat] [fault-header] time-seconds,name,"
+    "fault-drops,quarantined-events,downtime-seconds"
+)
 
 
 @dataclasses.dataclass
@@ -53,11 +60,14 @@ class Snapshot:
     events: np.ndarray  # [H]
     drops: np.ndarray  # [H]
     tail_drops: np.ndarray  # [H] NIC receive-buffer drop-tail losses
+    fault_drops: np.ndarray  # [H] packets lost to fault overlays
+    quarantined: np.ndarray  # [H] events voided by host crashes
 
     @staticmethod
     def zero(n: int) -> "Snapshot":
         z = lambda: np.zeros((n,), np.int64)
-        return Snapshot(z(), z(), z(), z(), z(), z(), z(), z(), z(), z())
+        return Snapshot(z(), z(), z(), z(), z(), z(), z(), z(), z(), z(),
+                        z(), z())
 
 
 def snapshot(st) -> Snapshot:
@@ -80,6 +90,8 @@ def snapshot(st) -> Snapshot:
         events=np.array(jax.device_get(st.stats.n_executed)),
         drops=np.array(jax.device_get(st.queues.drops)).astype(np.int64),
         tail_drops=np.array(jax.device_get(net.nic_rx.drops)),
+        fault_drops=np.array(jax.device_get(st.stats.n_fault_dropped)),
+        quarantined=np.array(jax.device_get(st.stats.n_quarantined)),
     )
 
 
@@ -95,13 +107,16 @@ class Tracker:
     def __init__(self, names: list[str], logger: Any,
                  log_info: tuple[str, ...] = ("node",),
                  info_of: dict[str, tuple[str, ...]] | None = None,
-                 level_of: dict[str, str] | None = None):
+                 level_of: dict[str, str] | None = None,
+                 faults: Any = None):
         self.names = names
         self.logger = logger
         self.log_info = log_info
         self.info_of = info_of or {}
         self.level_of = level_of or {}
+        self.faults = faults  # CompiledFaults -> emit the [fault] section
         self.prev = Snapshot.zero(len(names))
+        self._prev_ns = 0
         self._emitted_headers = False
 
     def _info(self, name: str) -> tuple[str, ...]:
@@ -119,34 +134,61 @@ class Tracker:
                 self.logger.log(sim_ns, "tracker", "message", SOCKET_HEADER)
             if any("ram" in self._info(n) for n in self.names):
                 self.logger.log(sim_ns, "tracker", "message", RAM_HEADER)
+            if self.faults is not None:
+                self.logger.log(sim_ns, "tracker", "message", FAULT_HEADER)
             self._emitted_headers = True
         t_s = sim_ns // 1_000_000_000
         p = self.prev
+        # a crash-restart re-templates the host's state, rewinding its
+        # socket/NIC accumulators — a negative interval delta just means
+        # "rebooted", so clamp to 0 (the lost remainder is attributed in
+        # the [fault] section instead)
+        d = lambda a, b: max(int(a) - int(b), 0)
         for i, name in enumerate(self.names):
             if "node" not in self._info(name):
                 continue
-            rx, tx = cur.rx[i] - p.rx[i], cur.tx[i] - p.tx[i]
+            rx, tx = d(cur.rx[i], p.rx[i]), d(cur.tx[i], p.tx[i])
             rxw, txw = (
-                cur.rx_wire[i] - p.rx_wire[i],
-                cur.tx_wire[i] - p.tx_wire[i],
+                d(cur.rx_wire[i], p.rx_wire[i]),
+                d(cur.tx_wire[i], p.tx_wire[i]),
             )
             self.logger.log(
                 sim_ns, name, self._level(name),
                 "[shadow-heartbeat] [node] "
                 f"{t_s},{name},{rx},{tx},{rxw},{txw},"
-                f"{cur.rx_pkts[i] - p.rx_pkts[i]},"
-                f"{cur.tx_pkts[i] - p.tx_pkts[i]},"
+                f"{d(cur.rx_pkts[i], p.rx_pkts[i])},"
+                f"{d(cur.tx_pkts[i], p.tx_pkts[i])},"
                 f"{max(rxw - rx, 0)},{max(txw - tx, 0)},"
-                f"{cur.retx[i] - p.retx[i]},"
+                f"{d(cur.retx[i], p.retx[i])},"
                 f"{cur.events[i] - p.events[i]},"
-                f"{cur.drops[i] - p.drops[i]},"
-                f"{cur.tail_drops[i] - p.tail_drops[i]}",
+                f"{d(cur.drops[i], p.drops[i])},"
+                f"{d(cur.tail_drops[i], p.tail_drops[i])}",
             )
         if any_socket:
             self._socket_lines(st, sim_ns, t_s)
         if any("ram" in self._info(n) for n in self.names):
             self._ram_lines(st, sim_ns, t_s)
+        if self.faults is not None:
+            self._fault_lines(cur, sim_ns, t_s)
         self.prev = cur
+        self._prev_ns = sim_ns
+
+    def _fault_lines(self, cur: Snapshot, sim_ns: int, t_s: int) -> None:
+        p = self.prev
+        downtime = self.faults.downtime_in(self._prev_ns, sim_ns)
+        for i, name in enumerate(self.names):
+            if "node" not in self._info(name):
+                continue
+            fd = cur.fault_drops[i] - p.fault_drops[i]
+            qr = cur.quarantined[i] - p.quarantined[i]
+            dt = downtime[i] if i < len(downtime) else 0.0
+            if fd == 0 and qr == 0 and dt == 0.0:
+                continue
+            self.logger.log(
+                sim_ns, name, self._level(name),
+                "[shadow-heartbeat] [fault] "
+                f"{t_s},{name},{fd},{qr},{dt:.3f}",
+            )
 
     def _ram_lines(self, st, sim_ns: int, t_s: int) -> None:
         """Per-host state occupancy (the reference's [ram] allocation

@@ -38,17 +38,23 @@ TOPO = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 </graphml>"""
 
 
-def _rerun_in_fresh_process(test_name: str) -> bool:
+def _rerun_in_fresh_process(test_name: str, record_property=None) -> bool:
     """Containment for the sockbuf<->shutdown interaction: when any
     tier already ran in this interpreter, re-execute the named capstone
     in a fresh subprocess (the solo conditions it is known green under)
     and report the child's verdict. Returns True when the child ran.
-    See the shutdown capstone's docstring for the interaction notes."""
+    The re-exec is surfaced on the pytest report via record_property
+    (`reexecuted_in_fresh_process` in the junit/report properties), so
+    a green run can be audited for which verdicts came from a child
+    interpreter. See the shutdown capstone's docstring for the
+    interaction notes."""
     import subprocess
     import sys
 
     from shadow_tpu.proc import native as _native
     if _native.N_RUNTIMES_CREATED == 0:
+        if record_property is not None:
+            record_property("reexecuted_in_fresh_process", False)
         return False
     r = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
@@ -57,6 +63,8 @@ def _rerun_in_fresh_process(test_name: str) -> bool:
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-1000:])
+    if record_property is not None:
+        record_property("reexecuted_in_fresh_process", True)
     return True
 
 
@@ -108,7 +116,7 @@ def test_reference_test_signal_unmodified(capfd):
     tier.close()
 
 
-def test_reference_test_sockbuf_unmodified(capfd):
+def test_reference_test_sockbuf_unmodified(capfd, record_property):
     """src/test/sockbuf/test_sockbuf.c (+ its test_common.c helper,
     compiled together): SO_SNDBUF/SO_RCVBUF get/set with the Linux 2x
     rule, user-set sizes disabling autotune, autotuned sizes growing
@@ -120,7 +128,8 @@ def test_reference_test_sockbuf_unmodified(capfd):
     src = "/root/reference/src/test/sockbuf/test_sockbuf.c"
     if not os.path.exists(src):
         pytest.skip("reference tree not mounted")
-    if _rerun_in_fresh_process("test_reference_test_sockbuf_unmodified"):
+    if _rerun_in_fresh_process("test_reference_test_sockbuf_unmodified",
+                               record_property):
         return
     plug = compile_posix_plugin(
         src, name="ref_test_sockbuf",
@@ -143,7 +152,7 @@ def test_reference_test_sockbuf_unmodified(capfd):
     tier.close()
 
 
-def test_reference_test_shutdown_unmodified(capfd):
+def test_reference_test_shutdown_unmodified(capfd, record_property):
     """src/test/shutdown/test_shutdown.c (+ test_common.c): real
     shutdown(2) half-close on the TCP machinery — ENOTCONN before
     connect and on UDP, EINVAL on a bad `how`, SHUT_RD reading buffered
@@ -164,7 +173,8 @@ def test_reference_test_shutdown_unmodified(capfd):
         # skip BEFORE the re-exec branch: a child pytest would report
         # its skip as exit 0 and masquerade as a pass
         pytest.skip("reference tree not mounted")
-    if _rerun_in_fresh_process("test_reference_test_shutdown_unmodified"):
+    if _rerun_in_fresh_process("test_reference_test_shutdown_unmodified",
+                               record_property):
         return
     from shadow_tpu.proc import ProcessTier
     from shadow_tpu.proc.native import compile_posix_plugin
