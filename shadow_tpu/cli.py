@@ -107,11 +107,37 @@ def make_parser() -> argparse.ArgumentParser:
                         "frac=0.2' (same attrs as the config's <fault> "
                         "element; see docs/6-Fault-Injection.md)")
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
-                   help="write a checkpoint every N sim seconds (0=off)")
+                   help="write a checkpoint every N sim seconds (0=off). "
+                        "Independent of the interval, SIGINT/SIGTERM "
+                        "checkpoint-then-exit and SIGUSR1 writes an "
+                        "on-demand checkpoint (docs/7-Supervised-Runs.md)")
     p.add_argument("--checkpoint-path", default="shadow_tpu.ckpt.npz",
-                   help="checkpoint file path (overwritten each interval)")
-    p.add_argument("--resume", default=None,
-                   help="resume from a checkpoint written by the same config")
+                   help="checkpoint file path (rotated each write; see "
+                        "--checkpoint-keep)")
+    p.add_argument("--checkpoint-keep", type=int, default=1, metavar="N",
+                   help="checkpoint generations to retain: PATH newest, "
+                        "PATH.1..PATH.N-1 older (default 1 = overwrite)")
+    p.add_argument("--resume", default=None, metavar="PATH|auto",
+                   help="resume from a checkpoint written by the same "
+                        "config; 'auto' picks the newest CRC-verified "
+                        "generation of --checkpoint-path, falling back "
+                        "past corrupt ones")
+    p.add_argument("--watchdog", type=float, default=0.0, metavar="SECONDS",
+                   help="per-window wall-clock deadline over the jitted "
+                        "step and the proc-tier syscall exchange: on "
+                        "stall, dump all thread stacks + a diagnostic "
+                        "bundle into --diag-dir and exit 75 instead of "
+                        "hanging (0=off; allow for one cold XLA compile "
+                        "inside the first window)")
+    p.add_argument("--validate", type=int, default=0, metavar="K",
+                   help="check EngineState invariants every K engine "
+                        "windows, off the jitted path (monotonic clock, "
+                        "sorted queue rows, non-negative counters, NaN "
+                        "scan); exit 70 naming the offending leaf on "
+                        "violation (0=off)")
+    p.add_argument("--diag-dir", default=".",
+                   help="directory for watchdog stall bundles and stack "
+                        "dumps")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
@@ -212,6 +238,8 @@ def main(argv=None) -> int:
             )
             return 2
 
+        from shadow_tpu.runtime import Supervisor
+
         t0 = time.perf_counter()
         tier_mesh = None
         if args.mesh:
@@ -227,10 +255,26 @@ def main(argv=None) -> int:
             interface_buffer=args.interface_buffer, mesh=tier_mesh,
             locality=args.locality,
         )
-        st = tier.run()
-        wall = time.perf_counter() - t0
-        for t_ns, pid, msg in tier.logs:
-            print(f"[{t_ns / SECOND:.6f}] [pid {pid}] {msg}")
+        sup = Supervisor(
+            watchdog_timeout=args.watchdog, diag_dir=args.diag_dir,
+            label="shadow_tpu.proc",
+            info=lambda: {
+                "tier": "process",
+                "live_pids": tier.live_pids(),
+                "exit_codes": {str(k): v for k, v in tier.exit_codes.items()},
+            },
+        )
+        try:
+            with sup:
+                st = tier.run(supervisor=sup)
+            wall = time.perf_counter() - t0
+        finally:
+            # abnormal exits (stall abort is os._exit and skips this, but
+            # signals/exceptions land here) still surface the plugin log
+            # lines collected so far and close the shim runtime
+            for t_ns, pid, msg in tier.logs:
+                print(f"[{t_ns / SECOND:.6f}] [pid {pid}] {msg}")
+            tier.close()
         summary = {
             "hosts": len(tier.sim.names),
             "sim_seconds": cfg.stoptime,
@@ -243,7 +287,10 @@ def main(argv=None) -> int:
             "queue_drops": int(jax.device_get(st.queues.drops.sum())),
         }
         print(json.dumps(summary))
-        tier.close()
+        if sup.stop_requested:
+            print(f"interrupted by signal {sup.stop_signum}; the process "
+                  "tier has no checkpoint to write", file=sys.stderr)
+            return sup.exit_code()
         return 0 if all(c == 0 for c in tier.exit_codes.values()) else 1
 
     t0 = time.perf_counter()
@@ -304,9 +351,24 @@ def main(argv=None) -> int:
     st = sim.state0
     sim_s = 0.0
     if args.resume:
-        from shadow_tpu.utils import load_checkpoint
+        from shadow_tpu.utils import find_resume_checkpoint, load_checkpoint
 
-        st, meta = load_checkpoint(args.resume, sim.state0)
+        resume_path = args.resume
+        if resume_path == "auto":
+            try:
+                found = find_resume_checkpoint(args.checkpoint_path)
+            except ValueError as e:
+                print(f"error: --resume auto: {e}", file=sys.stderr)
+                return 2
+            if found is None:
+                print("error: --resume auto: no checkpoint generations at "
+                      f"{args.checkpoint_path}", file=sys.stderr)
+                return 2
+            resume_path, _auto_meta, skipped = found
+            for p, reason in skipped:
+                print(f"warning: --resume auto: skipping {p}: {reason}",
+                      file=sys.stderr)
+        st, meta = load_checkpoint(resume_path, sim.state0)
         if meta.get("seed") is not None and meta["seed"] != args.seed:
             print(f"error: checkpoint was written with --seed {meta['seed']}"
                   f" but this run uses --seed {args.seed}; resume would not "
@@ -318,7 +380,7 @@ def main(argv=None) -> int:
                   "it was written from a different config", file=sys.stderr)
             return 2
         sim_s = float(jax.device_get(st.now)) / SECOND
-        print(f"resumed from {args.resume} at sim time {sim_s:.3f}s "
+        print(f"resumed from {resume_path} at sim time {sim_s:.3f}s "
               f"(meta: {meta})", file=sys.stderr)
     stop_s = cfg.stoptime
     # independent sim-time cadences; the run loop steps to whichever event
@@ -342,34 +404,112 @@ def main(argv=None) -> int:
         )
         print(f"pcap capture: {len(sim.pcap_gids)} hosts -> {sim.pcap_dir}/",
               file=sys.stderr)
-    t1 = time.perf_counter()
-    while sim_s < stop_s:
-        nxt = min(next_hb, next_ckpt, stop_s)
-        st = sim.run(int(nxt * SECOND), state=st)
-        st.now.block_until_ready()
-        sim_s = nxt
-        if sim_s >= next_hb:
-            tracker.heartbeat(st, int(sim_s * SECOND))
-            logger.flush()
-            if drain is not None:
-                drain.drain(st.hosts.net.cap)
-            next_hb += hb
-        if sim_s >= next_ckpt:
-            from shadow_tpu.utils import save_checkpoint
+    from shadow_tpu.runtime import EXIT_INVARIANT, Supervisor
+    from shadow_tpu.runtime.invariants import InvariantViolation, validate
+    from shadow_tpu.utils import save_checkpoint
+    from shadow_tpu.utils.tracker import SupervisorHeartbeat
 
-            save_checkpoint(
-                args.checkpoint_path, st,
-                meta={"sim_seconds": sim_s, "seed": args.seed,
+    sup = Supervisor(
+        watchdog_timeout=args.watchdog, diag_dir=args.diag_dir,
+        info=lambda: {"tier": "device",
+                      "checkpoint_path": args.checkpoint_path,
                       "config_digest": cfg_digest},
-            )
-            next_ckpt += ck
+    )
+    sup_hb = SupervisorHeartbeat(logger, watchdog=sup.watchdog)
+
+    def write_checkpoint(path=None, **extra_meta):
+        # emergency checkpoints go to an explicit side path, NOT into
+        # the rotation: a crashing run must never push the last known
+        # good generation off the retention horizon
+        save_checkpoint(
+            path or args.checkpoint_path, st,
+            meta={"sim_seconds": sim_s, "seed": args.seed,
+                  "config_digest": cfg_digest, **extra_meta},
+            keep=1 if path else args.checkpoint_keep,
+        )
+        sup_hb.checkpoint_written()
+
+    last_validated_windows = 0
+    prev_validated_now = None
+    t1 = time.perf_counter()
+    try:
+        with sup:
+            while sim_s < stop_s:
+                nxt = min(next_hb, next_ckpt, stop_s)
+                st = sim.run(int(nxt * SECOND), state=st)
+                st.now.block_until_ready()
+                sim_s = nxt
+                summary_now = sim.summary(st)
+                sup.pet(sim_seconds=sim_s, **summary_now)
+                sup_hb.observe_margin()
+                if args.validate > 0 and (
+                    summary_now["windows"] - last_validated_windows
+                    >= args.validate
+                ):
+                    prev_validated_now = validate(
+                        st, prev_now=prev_validated_now
+                    )
+                    last_validated_windows = summary_now["windows"]
+                if sim_s >= next_hb:
+                    tracker.heartbeat(st, int(sim_s * SECOND))
+                    sup_hb.beat(int(sim_s * SECOND), summary_now)
+                    logger.flush()
+                    if drain is not None:
+                        drain.drain(st.hosts.net.cap)
+                    next_hb += hb
+                if sup.take_checkpoint_request():  # SIGUSR1
+                    write_checkpoint(on_demand=True)
+                    print("checkpoint written on SIGUSR1 -> "
+                          f"{args.checkpoint_path} (sim {sim_s:.3f}s)",
+                          file=sys.stderr)
+                if sup.stop_requested:
+                    # graceful shutdown: checkpoint regardless of
+                    # --checkpoint-interval, then exit 128+signum
+                    write_checkpoint(interrupted=sup.stop_signum)
+                    break
+                if sim_s >= next_ckpt:
+                    write_checkpoint()
+                    next_ckpt += ck
+    except InvariantViolation as e:
+        # deliberately NO checkpoint here: the state just failed its own
+        # consistency checks, and writing it would rotate a known-good
+        # generation out in favor of a corrupt one
+        print(f"shadow_tpu: INVARIANT VIOLATION at sim {sim_s:.3f}s\n{e}",
+              file=sys.stderr)
+        return EXIT_INVARIANT
+    except BaseException as e:
+        # unhandled driver failure: best-effort emergency checkpoint of
+        # the last completed window batch, then re-raise — diagnosis
+        # must never mask the original error
+        try:
+            epath = args.checkpoint_path + ".emergency"
+            write_checkpoint(path=epath, emergency=repr(e)[:200])
+            print(f"emergency checkpoint -> {epath} (sim {sim_s:.3f}s)",
+                  file=sys.stderr)
+        except Exception as e2:
+            print(f"emergency checkpoint failed: {e2!r}", file=sys.stderr)
+        raise
+    finally:
+        # interrupted and failed runs keep their observability output:
+        # flush buffered log lines and close every pcap writer so the
+        # on-disk captures are valid up to the last drain
+        logger.flush()
+        if drain is not None:
+            try:
+                drain.drain(st.hosts.net.cap)
+            except Exception:
+                pass
+            drain.close()
+            if drain.lost:
+                print(f"pcap: {drain.lost} records lost to ring overrun "
+                      "(raise --heartbeat-frequency cadence)",
+                      file=sys.stderr)
     wall = time.perf_counter() - t1
-    if drain is not None:
-        drain.drain(st.hosts.net.cap)
-        drain.close()
-        if drain.lost:
-            print(f"pcap: {drain.lost} records lost to ring overrun "
-                  "(raise --heartbeat-frequency cadence)", file=sys.stderr)
+    if sup.stop_requested:
+        print(f"interrupted by signal {sup.stop_signum}: checkpoint at "
+              f"{args.checkpoint_path} (sim {sim_s:.3f}s of {stop_s:.0f}s); "
+              "resume with --resume auto", file=sys.stderr)
+        return sup.exit_code()
 
     stats = st.stats
     executed = int(jax.device_get(stats.n_executed.sum()))

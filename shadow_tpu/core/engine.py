@@ -191,6 +191,28 @@ class EngineState:
     fault_epoch: jax.Array  # i32[] (replicated)
 
 
+def state_summary(state: EngineState) -> dict:
+    """Cheap host-side progress snapshot of an EngineState.
+
+    One batched device_get of a handful of scalars — safe to call at
+    every window boundary. This is what the supervised-run layer
+    (shadow_tpu/runtime/) pets its watchdog with and what the stall
+    diagnostic bundle records as "last known progress": the frontier
+    (clock) time, the window count, and the executed-event total.
+    """
+    now, windows, executed, sweeps, drops = jax.device_get((
+        state.now, state.stats.n_windows, state.stats.n_executed.sum(),
+        state.stats.n_sweeps, state.queues.drops.sum(),
+    ))
+    return {
+        "now_ns": int(now),
+        "windows": int(windows),
+        "executed": int(executed),
+        "sweeps": int(sweeps),
+        "queue_drops": int(drops),
+    }
+
+
 # Handler signature: (host_state_slice, ev: Events scalar, key) ->
 #                    (host_state_slice', Emit)
 Handler = Callable[[Any, Events, jax.Array], tuple[Any, Emit]]

@@ -199,6 +199,7 @@ class ShimRuntime:
         self._rt = lib.shim_init()
         self._req_buf = (ShimReq * max_reqs)()
         self._max_reqs = max_reqs
+        self._spawned: list[int] = []
 
     def close(self):
         if self._rt:
@@ -214,7 +215,16 @@ class ShimRuntime:
             raise RuntimeError(
                 self._lib.shim_last_error(self._rt).decode()
             )
+        self._spawned.append(pid)
         return pid
+
+    def live_pids(self) -> list[int]:
+        """Pids the runtime still considers running — the green-thread
+        ground truth the watchdog's stall bundle records (a spinning
+        plugin is *running*, which is exactly the problem)."""
+        if not self._rt:
+            return []
+        return [p for p in self._spawned if self.exit_code(p) is None]
 
     def start(self, pid: int) -> None:
         self._lib.shim_start(self._rt, pid)

@@ -704,8 +704,26 @@ class ProcessTier:
         self._prev_fin = fin
         self._prev_gen = cgen.copy()
 
+    def live_pids(self) -> list[int]:
+        """Virtual pids still running, by the native runtime's green-
+        thread ground truth (driver bookkeeping can lag a window behind
+        on kills; the runtime cannot) — recorded in the watchdog's
+        stall diagnostic bundle."""
+        alive = set(self.rt.live_pids())
+        return sorted(p for p in self.pid_host if p in alive)
+
     # ---------------------------------------------------------------- run
-    def run(self, stop_s: float | None = None):
+    def run(self, stop_s: float | None = None, supervisor=None):
+        """Drive the window loop to the stop time.
+
+        `supervisor` (runtime.Supervisor, optional) is petted once per
+        window with the frontier time — covering BOTH blocking sites,
+        the jitted step and the native `shim_pump` (a plugin spinning
+        without yielding hangs the pump forever; the watchdog converts
+        that into a stall abort with the live pids in the bundle) — and
+        its stop requests (SIGINT/SIGTERM) end the run at the next
+        window boundary.
+        """
         sim = self.sim
         stop_ns = int(stop_s * SECOND) if stop_s is not None else sim.stop_ns
         st = sim.state0
@@ -778,6 +796,17 @@ class ProcessTier:
 
             reqs = self.rt.pump(now, comps)
             st = self._inject(st, self._translate(reqs, now), now)
+            if supervisor is not None:
+                supervisor.pet(
+                    now_ns=now, n_live_processes=len(self.live_pids()),
+                    n_exited=len(self.exit_codes),
+                )
+                if supervisor.stop_requested:
+                    # graceful shutdown: the proc tier has no checkpoint
+                    # (native endpoint streams live host-side), so "at
+                    # the next window boundary" just means stop cleanly
+                    # — logs and exit codes collected so far survive
+                    break
 
             if now >= stop_ns:
                 break

@@ -1,0 +1,31 @@
+"""Runtime resilience layer: supervised simulation runs.
+
+The reference's robustness story ends at its logging: a wedged plugin
+hangs the whole pthread barrier dance forever, SIGTERM loses the run,
+and there is nothing to checkpoint anyway (SURVEY.md §5). Here the
+*simulated world* already survives chaos (faults/), so this package
+makes the *driver process* survive it too:
+
+- `supervisor.Watchdog` — wall-clock stall detector over the jitted
+  window step and the proc-tier syscall exchange; on stall it dumps
+  every thread's stack, writes a diagnostic bundle, and aborts with a
+  distinct exit code instead of hanging under an opaque `timeout -k`.
+- `supervisor.Supervisor` — signal-aware run-loop wrapper: SIGINT and
+  SIGTERM request checkpoint-then-exit at the next window boundary,
+  SIGUSR1 an on-demand checkpoint.
+- `invariants` — off-the-hot-path EngineState validator (monotonic
+  clock, sorted queue rows with empties last, non-negative counters,
+  NaN scan) that fails loudly with the offending leaf path.
+
+Nothing in this package imports jax at module load: the watchdog and
+signal plumbing are usable (and unit-testable) without touching a
+device backend.
+"""
+
+from shadow_tpu.runtime.supervisor import (  # noqa: F401
+    EXIT_INVARIANT,
+    EXIT_STALL,
+    Supervisor,
+    Watchdog,
+    signal_exit_code,
+)

@@ -1,0 +1,151 @@
+"""EngineState invariant guard — off the jitted hot path.
+
+A miscompile, a bad backend, or a buggy handler can violate the engine's
+structural contracts *silently*: the PR-1 shard_map leak produced states
+that were wrong long before any test assertion looked at them. This
+module re-checks, host-side on a device_get'd snapshot, the invariants
+the jitted loop assumes but never verifies (verifying them in-graph
+would cost every window what they cost once per validation interval):
+
+- the clock is non-negative and monotonic across validations;
+- every host's queue rows are sorted by the engine's total order
+  (time, src, seq-as-u32 — events.pack_srcseq) with empty slots
+  (time == TIME_INVALID) packed last;
+- counters that only ever increment are non-negative (stats, queue
+  drops, per-source sequence numbers, executed-event counts);
+- no float leaf anywhere in the state holds NaN/Inf.
+
+Failures raise `InvariantViolation` naming the offending leaf path and
+host row, so a corrupted run dies loudly at the next validation boundary
+instead of checkpointing garbage for hours.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class InvariantViolation(RuntimeError):
+    """EngineState violated a structural contract; state is corrupt."""
+
+
+def _leaf_items(tree: Any):
+    import jax
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        yield jax.tree_util.keystr(path), leaf
+
+
+def check_state(state: Any, *, prev_now: int | None = None,
+                max_violations: int = 10) -> list[str]:
+    """Return a list of violation strings (empty = state is sound).
+
+    `prev_now` is the clock observed at the previous validation; pass it
+    to catch time running backwards between checks. One batched
+    device_get; everything after is numpy.
+    """
+    import jax
+
+    from shadow_tpu.core.timebase import TIME_INVALID
+
+    viols: list[str] = []
+
+    def add(msg: str) -> bool:
+        viols.append(msg)
+        return len(viols) >= max_violations
+
+    now, q_time, q_src, q_seq = (
+        np.asarray(x) for x in jax.device_get(
+            (state.now, state.queues.time, state.queues.src,
+             state.queues.seq)
+        )
+    )
+
+    # 1. clock
+    if int(now) < 0:
+        add(f".now: negative clock {int(now)}")
+    if prev_now is not None and int(now) < int(prev_now):
+        add(f".now: clock ran backwards {int(prev_now)} -> {int(now)}")
+
+    # 2. queue rows: empties last, valid prefix sorted by (time, src, seq)
+    valid = q_time != TIME_INVALID
+    # empties-last == the valid mask is a prefix of each row
+    bad_prefix = np.nonzero((~valid[:, :-1] & valid[:, 1:]).any(axis=1))[0]
+    for h in bad_prefix[:3]:
+        if add(f".queues.time[host {int(h)}]: empty slot ahead of a live "
+               "event (empties-last invariant broken)"):
+            return viols
+    # lexicographic order over the valid prefix; the engine ties on
+    # pack_srcseq, i.e. src then seq *as u32* (events.pack_srcseq)
+    seq_u32 = q_seq.astype(np.int64) & 0xFFFFFFFF
+    src_k = np.where(valid, q_src, 0)
+    seq_k = np.where(valid, seq_u32, 0)
+    both = valid[:, :-1] & valid[:, 1:]
+    dt = q_time[:, 1:] - q_time[:, :-1]
+    ds = src_k[:, 1:] - src_k[:, :-1]
+    dq = seq_k[:, 1:] - seq_k[:, :-1]
+    unsorted = both & (
+        (dt < 0)
+        | ((dt == 0) & (ds < 0))
+        | ((dt == 0) & (ds == 0) & (dq < 0))
+    )
+    for h in np.nonzero(unsorted.any(axis=1))[0][:3]:
+        c = int(np.nonzero(unsorted[h])[0][0])
+        if add(
+            f".queues[host {int(h)}]: rows {c},{c + 1} out of "
+            f"(time,src,seq) order: "
+            f"({int(q_time[h, c])},{int(q_src[h, c])},{int(q_seq[h, c])})"
+            f" > ({int(q_time[h, c + 1])},{int(q_src[h, c + 1])},"
+            f"{int(q_seq[h, c + 1])})"
+        ):
+            return viols
+
+    # 3. monotone counters must be non-negative
+    counters = {
+        ".stats": state.stats,
+        ".queues.drops": state.queues.drops,
+        ".src_seq": state.src_seq,
+        ".exec_cnt": state.exec_cnt,
+    }
+    for base, sub in counters.items():
+        for path, leaf in _leaf_items(sub):
+            arr = np.asarray(jax.device_get(leaf))
+            if not np.issubdtype(arr.dtype, np.integer):
+                continue
+            if (arr < 0).any():
+                idx = np.unravel_index(int(np.argmin(arr)), arr.shape)
+                if add(f"{base}{path}{list(idx)}: negative counter "
+                       f"{int(arr[idx])}"):
+                    return viols
+
+    # 4. NaN/Inf scan over every float leaf of the whole state
+    for path, leaf in _leaf_items(state):
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        finite = np.isfinite(arr)
+        if not finite.all():
+            idx = np.unravel_index(int(np.argmin(finite)), arr.shape)
+            if add(f"{path}{list(idx)}: non-finite value {arr[idx]!r}"):
+                return viols
+
+    return viols
+
+
+def validate(state: Any, *, prev_now: int | None = None) -> int:
+    """Raise InvariantViolation listing every violation found; return
+    the state's clock (feed it back as the next call's prev_now)."""
+    import jax
+
+    viols = check_state(state, prev_now=prev_now)
+    if viols:
+        raise InvariantViolation(
+            "EngineState invariant violation"
+            + ("s" if len(viols) > 1 else "")
+            + " (state is corrupt; do not resume from checkpoints written "
+            "after the previous clean validation):\n  "
+            + "\n  ".join(viols)
+        )
+    return int(jax.device_get(state.now))

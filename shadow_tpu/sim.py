@@ -218,6 +218,15 @@ class Simulation:
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
         return self._jit_step(state, stop)
 
+    def summary(self, state) -> dict:
+        """Host-side progress snapshot (frontier time, window count,
+        executed events) — what the supervised run loop pets its
+        watchdog with and the stall bundle records; see
+        core.engine.state_summary."""
+        from shadow_tpu.core.engine import state_summary
+
+        return state_summary(state)
+
 
 def _plugin_tokens(cfg: ShadowConfig, plugin_id: str) -> set[str]:
     """Registry-matchable name tokens for a plugin: its id plus its path

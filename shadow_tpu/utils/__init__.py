@@ -1,4 +1,7 @@
 from shadow_tpu.utils.checkpoint import (  # noqa: F401
+    checkpoint_generations,
+    find_resume_checkpoint,
     load_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
 )

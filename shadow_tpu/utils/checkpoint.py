@@ -14,12 +14,23 @@ metadata blob recording leaf paths/shapes/dtypes for validation and a
 free-form user dict (config digest, sim time, version). Restoring requires
 a template state with identical tree structure (rebuild the simulation
 from the same config, then load into its state0).
+
+Integrity & rotation (the supervised-runs layer, docs/7-Supervised-Runs.md):
+every leaf carries a CRC32 in the header, verified on load — the zip
+container's own CRCs only cover the compressed members, not a write that
+flipped bits before compression or a tool that rewrote a member. `keep=N`
+rotates generations (`path` newest, `path.1` … `path.N-1` older), and
+`find_resume_checkpoint` implements `--resume auto`: newest generation
+that verifies wins, corrupt ones are skipped with a reason.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import zipfile
+import zlib
 from typing import Any
 
 import jax
@@ -28,7 +39,11 @@ import numpy as np
 # v2: event-queue rows carry a sorted-by-(time,src,seq) invariant (empties
 # last) that the engine's frontier reads rely on; v1 checkpoints (arbitrary
 # slot order) would silently execute events out of order if loaded.
-FORMAT_VERSION = 3  # v3: EngineState.fault_epoch + fault Stats counters
+# v3: EngineState.fault_epoch + fault Stats counters.
+# v4: per-leaf CRC32s in the header. Loading still accepts v3 (same tree
+# semantics, just no integrity data to verify against).
+FORMAT_VERSION = 4
+_LOADABLE_VERSIONS = (3, 4)
 
 
 def _leaf_paths(tree: Any) -> list[str]:
@@ -38,19 +53,64 @@ def _leaf_paths(tree: Any) -> list[str]:
     return paths
 
 
-def save_checkpoint(path: str, state: Any, meta: dict | None = None) -> None:
-    """Write `state` (any pytree of arrays) to `path` as .npz."""
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _rotate(path: str, keep: int) -> None:
+    """Shift existing generations one slot older: path -> path.1 -> …
+    -> path.{keep-1}; anything at or beyond the keep horizon is removed
+    (so lowering --checkpoint-keep actually reclaims the disk)."""
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    for i in range(n, keep - 1, -1):  # prune the tail beyond the horizon
+        stale = f"{path}.{i}"
+        if os.path.exists(stale):
+            os.remove(stale)
+    for i in range(min(n, keep - 1), 0, -1):
+        src = path if i == 1 else f"{path}.{i - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i}")
+
+
+def checkpoint_generations(path: str) -> list[str]:
+    """Existing generation files, newest first (path, path.1, …)."""
+    out = [path] if os.path.exists(path) else []
+    suffixed = []
+    base = os.path.basename(path)
+    d = os.path.dirname(os.path.abspath(path))
+    if os.path.isdir(d):
+        pat = re.compile(re.escape(base) + r"\.(\d+)$")
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                suffixed.append((int(m.group(1)), os.path.join(
+                    os.path.dirname(path) or ".", name)))
+    out += [p for _, p in sorted(suffixed)]
+    return out
+
+
+def save_checkpoint(path: str, state: Any, meta: dict | None = None,
+                    keep: int = 1) -> None:
+    """Write `state` (any pytree of arrays) to `path` as .npz.
+
+    `keep > 1` rotates: the previous `path` becomes `path.1` (and so on
+    up to `path.{keep-1}`) before the new file lands, so a corrupted
+    newest generation never strands the run without a fallback.
+    """
     leaves, _ = jax.tree_util.tree_flatten(state)
-    leaves = jax.device_get(leaves)
+    leaves = [np.asarray(x) for x in jax.device_get(leaves)]
     header = {
         "format_version": FORMAT_VERSION,
         "n_leaves": len(leaves),
         "paths": _leaf_paths(state),
         "shapes": [list(np.shape(x)) for x in leaves],
-        "dtypes": [str(np.asarray(x).dtype) for x in leaves],
+        "dtypes": [str(x.dtype) for x in leaves],
+        "crc32": [_crc(x) for x in leaves],
         "meta": meta or {},
     }
-    arrs = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrs = {f"leaf_{i}": x for i, x in enumerate(leaves)}
     arrs["__header__"] = np.frombuffer(
         json.dumps(header).encode("utf-8"), dtype=np.uint8
     )
@@ -62,6 +122,8 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None) -> None:
         np.savez_compressed(f, **arrs)
         f.flush()
         os.fsync(f.fileno())
+    if keep > 1:
+        _rotate(path, keep)
     os.replace(tmp, path)
     dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
     try:
@@ -70,47 +132,123 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None) -> None:
         os.close(dfd)
 
 
+def _read_raw(path: str) -> tuple[dict, list[np.ndarray]]:
+    """Read header + every leaf, mapping container-level damage
+    (truncation, zip corruption, missing members) to a ValueError that
+    names the file instead of leaking a zipfile traceback."""
+    try:
+        with np.load(path) as data:
+            header = json.loads(bytes(data["__header__"]).decode("utf-8"))
+            leaves = [data[f"leaf_{i}"] for i in range(header["n_leaves"])]
+    # ValueError covers np.load mistaking a non-archive for a pickle
+    except (zipfile.BadZipFile, KeyError, EOFError, OSError, ValueError,
+            json.JSONDecodeError) as e:
+        raise ValueError(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e})"
+        ) from e
+    ver = header.get("format_version")
+    if ver not in _LOADABLE_VERSIONS:
+        raise ValueError(
+            f"checkpoint {path!r}: format {ver} not in loadable set "
+            f"{_LOADABLE_VERSIONS} (current writer: {FORMAT_VERSION})"
+        )
+    return header, leaves
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Fully read `path` and verify every leaf against its header CRC32.
+
+    Returns the user meta dict on success; raises ValueError naming the
+    file and the first mismatching leaf otherwise. v3 files (no CRCs)
+    pass the container checks only.
+    """
+    header, leaves = _read_raw(path)
+    crcs = header.get("crc32")
+    if crcs is not None:
+        for i, (arr, want) in enumerate(zip(leaves, crcs)):
+            got = _crc(arr)
+            if got != want:
+                pth = header["paths"][i] if i < len(header["paths"]) else "?"
+                raise ValueError(
+                    f"checkpoint {path!r}: CRC mismatch on leaf {i} ({pth}): "
+                    f"stored {want:#010x}, computed {got:#010x} — the file "
+                    "was damaged after it was written"
+                )
+    return header.get("meta", {})
+
+
+def find_resume_checkpoint(path: str):
+    """`--resume auto`: newest generation of `path` that verifies.
+
+    Returns (chosen_path, meta, skipped) where skipped is a list of
+    (path, reason) for newer generations that failed verification;
+    returns None when no generation files exist at all. Raises
+    ValueError when generations exist but none verifies.
+    """
+    gens = checkpoint_generations(path)
+    if not gens:
+        return None
+    skipped: list[tuple[str, str]] = []
+    for p in gens:
+        try:
+            meta = verify_checkpoint(p)
+        except ValueError as e:
+            skipped.append((p, str(e)))
+            continue
+        return p, meta, skipped
+    raise ValueError(
+        "no verifiable checkpoint generation:\n  "
+        + "\n  ".join(f"{p}: {r}" for p, r in skipped)
+    )
+
+
 def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:
     """Load a checkpoint into the structure of `template`.
 
-    Returns (state, meta). Raises ValueError on structural mismatch —
-    checkpoint files are only portable across identical builds (same
-    config, host count, socket/queue capacities).
+    Returns (state, meta). Raises ValueError on container corruption,
+    per-leaf CRC mismatch, or structural mismatch — checkpoint files are
+    only portable across identical builds (same config, host count,
+    socket/queue capacities).
     """
-    with np.load(path) as data:
-        header = json.loads(bytes(data["__header__"]).decode("utf-8"))
-        if header.get("format_version") != FORMAT_VERSION:
+    header, leaves = _read_raw(path)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if header["n_leaves"] != len(t_leaves):
+        raise ValueError(
+            f"checkpoint has {header['n_leaves']} leaves, template has "
+            f"{len(t_leaves)} — was it built from the same config?"
+        )
+    paths = _leaf_paths(template)
+    if header["paths"] != paths:
+        diff = [
+            f"  {a} (checkpoint) vs {b} (template)"
+            for a, b in zip(header["paths"], paths)
+            if a != b
+        ]
+        raise ValueError(
+            "checkpoint tree structure differs from template:\n"
+            + "\n".join(diff[:10])
+        )
+    crcs = header.get("crc32") or [None] * len(leaves)
+    new_leaves = []
+    for i, (tmpl, pth, arr, want_crc) in enumerate(
+        zip(t_leaves, paths, leaves, crcs)
+    ):
+        want_shape = tuple(np.shape(tmpl))
+        want_dtype = (
+            np.asarray(tmpl).dtype if not hasattr(tmpl, "dtype")
+            else tmpl.dtype
+        )
+        if arr.shape != want_shape or str(arr.dtype) != str(want_dtype):
             raise ValueError(
-                f"checkpoint format {header.get('format_version')} != "
-                f"{FORMAT_VERSION}"
+                f"leaf {i} ({pth}): checkpoint {arr.shape}/{arr.dtype} vs "
+                f"template {want_shape}/{want_dtype}"
             )
-        t_leaves, treedef = jax.tree_util.tree_flatten(template)
-        if header["n_leaves"] != len(t_leaves):
+        if want_crc is not None and _crc(arr) != want_crc:
             raise ValueError(
-                f"checkpoint has {header['n_leaves']} leaves, template has "
-                f"{len(t_leaves)} — was it built from the same config?"
+                f"checkpoint {path!r}: CRC mismatch on leaf {i} ({pth}) — "
+                "the file was damaged after it was written"
             )
-        paths = _leaf_paths(template)
-        if header["paths"] != paths:
-            diff = [
-                f"  {a} (checkpoint) vs {b} (template)"
-                for a, b in zip(header["paths"], paths)
-                if a != b
-            ]
-            raise ValueError(
-                "checkpoint tree structure differs from template:\n"
-                + "\n".join(diff[:10])
-            )
-        new_leaves = []
-        for i, (tmpl, pth) in enumerate(zip(t_leaves, paths)):
-            arr = data[f"leaf_{i}"]
-            want_shape = tuple(np.shape(tmpl))
-            want_dtype = np.asarray(tmpl).dtype if not hasattr(tmpl, "dtype") else tmpl.dtype
-            if arr.shape != want_shape or str(arr.dtype) != str(want_dtype):
-                raise ValueError(
-                    f"leaf {i} ({pth}): checkpoint {arr.shape}/{arr.dtype} vs "
-                    f"template {want_shape}/{want_dtype}"
-                )
-            new_leaves.append(jax.numpy.asarray(arr))
-        state = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        return state, header.get("meta", {})
+        new_leaves.append(jax.numpy.asarray(arr))
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state, header.get("meta", {})

@@ -41,6 +41,18 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def pytest_configure(config):
+    """Suite-wide hang diagnosis: any single test stuck past this limit
+    gets every thread's stack dumped by pytest's faulthandler plugin —
+    the same diagnosis the runtime watchdog gives production runs. Set
+    just under CI's 870s outer `timeout -k` so the dump happens while
+    the process is still alive to print it. The raw inicfg dict is read
+    lazily per test (and getini would cache a premature default), so
+    only set it when pyproject didn't."""
+    if "faulthandler_timeout" not in config.inicfg:
+        config.inicfg["faulthandler_timeout"] = "840"
+
+
 def pytest_collection_modifyitems(config, items):
     """Auto-mark tests so a smoke lane exists: `pytest -m "not slow"`
     skips the heavyweight end-to-end runs. Measured warm-cache on a

@@ -76,3 +76,139 @@ def test_checkpoint_structural_mismatch_rejected(tmp_path):
     other = build_simulation(parse_config(CONFIG), seed=7, n_sockets=4)
     with pytest.raises(ValueError):
         load_checkpoint(path, other.state0)
+
+
+# ---------------------------------------------------------------------------
+# Integrity + rotation mechanics need no simulator: any pytree works, and
+# a plain dict keeps these tests millisecond-fast.
+
+import json  # noqa: E402
+import os  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from shadow_tpu.utils import (  # noqa: E402
+    checkpoint_generations,
+    find_resume_checkpoint,
+    verify_checkpoint,
+)
+
+
+def _tree():
+    return {
+        "a": jnp.arange(64, dtype=jnp.int64),
+        "b": jnp.linspace(0.0, 1.0, 32, dtype=jnp.float32),
+    }
+
+
+def test_checkpoint_crc_detects_bit_flip(tmp_path):
+    """A flipped payload bit that keeps the zip container intact must
+    still be caught: per-leaf CRCs, not just np.load succeeding."""
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, _tree(), meta={"k": 1})
+
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k].copy() for k in z.files}
+    leaf = arrays["leaf_0"]
+    leaf.flat[3] ^= 1  # single bit flip, same shape/dtype
+    np.savez(path, **arrays)  # header (with original CRCs) unchanged
+
+    with pytest.raises(ValueError, match="(?i)crc"):
+        verify_checkpoint(path)
+    with pytest.raises(ValueError, match="(?i)crc"):
+        load_checkpoint(path, _tree())
+
+
+def test_checkpoint_truncated_file_is_clear_error(tmp_path):
+    """Satellite: a truncated/corrupt .npz (killed mid-write without the
+    atomic rename, disk full, ...) must raise a ValueError naming the
+    file — not leak BadZipFile/KeyError out of numpy internals."""
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, _tree())
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])
+
+    with pytest.raises(ValueError, match="ck.npz"):
+        load_checkpoint(path, _tree())
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        verify_checkpoint(path)
+
+    # a non-archive file (e.g. some stray artifact) reads the same way
+    open(path, "wb").write(b"not a checkpoint")
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        verify_checkpoint(path)
+
+
+def test_checkpoint_header_missing_is_clear_error(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, _tree())
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k].copy() for k in z.files if k != "__header__"}
+    np.savez(path, **arrays)
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        verify_checkpoint(path)
+
+
+def test_checkpoint_rotation_keeps_n_generations(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    for i in range(4):
+        save_checkpoint(path, _tree(), meta={"gen": i}, keep=2)
+
+    gens = checkpoint_generations(path)
+    assert gens == [path, path + ".1"]
+    assert not os.path.exists(path + ".2")  # pruned beyond the horizon
+    assert verify_checkpoint(path)["gen"] == 3  # newest at the bare path
+    assert verify_checkpoint(path + ".1")["gen"] == 2
+
+
+def test_resume_auto_falls_back_past_corrupt_newest(tmp_path):
+    """Satellite: --resume auto must skip a truncated newest generation
+    and pick the older one that still verifies."""
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, _tree(), meta={"gen": 0}, keep=3)
+    save_checkpoint(path, _tree(), meta={"gen": 1}, keep=3)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:100])  # newest is now garbage
+
+    chosen, meta, skipped = find_resume_checkpoint(path)
+    assert chosen == path + ".1"
+    assert meta["gen"] == 0
+    assert [p for p, _ in skipped] == [path]
+
+    # no generation at all -> None (caller prints its own error)
+    assert find_resume_checkpoint(str(tmp_path / "absent.npz")) is None
+
+    # every generation corrupt -> ValueError listing each candidate
+    open(path + ".1", "wb").write(b"junk")
+    with pytest.raises(ValueError, match="no verifiable checkpoint"):
+        find_resume_checkpoint(path)
+
+
+def test_checkpoint_format_v3_still_loads(tmp_path):
+    """Pre-CRC checkpoints (format 3) stay loadable: strip the crc32
+    field and downgrade the version marker, as an old writer would have
+    produced."""
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(path, _tree(), meta={"old": True})
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k].copy() for k in z.files}
+    header = json.loads(bytes(arrays["__header__"]).decode())
+    header["format_version"] = 3
+    del header["crc32"]
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8
+    )
+    np.savez(path, **arrays)
+
+    tree, meta = load_checkpoint(path, _tree())
+    assert meta == {"old": True}
+    assert jnp.array_equal(tree["a"], _tree()["a"])
+
+    # ...but an unknown future version is refused
+    header["format_version"] = 99
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8
+    )
+    np.savez(path, **arrays)
+    with pytest.raises(ValueError, match="format"):
+        load_checkpoint(path, _tree())
