@@ -106,22 +106,26 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
     from shadow_tpu.core.timebase import SECOND, seconds
     from shadow_tpu.models import phold
 
-    eng, init = phold.build(
-        N_HOSTS,
-        capacity=capacity,
-        latency_ns=seconds(LATENCY_S),
-        mean_delay_ns=seconds(MEAN_DELAY_S),
-        msgs_per_host=MSGS_PER_HOST,
-        seed=SEED,
-        hot_hosts=hot_hosts,
-        hot_weight=hot_weight,
-        batched=batched,
-    )
-    run = jax.jit(eng.run)
+    from shadow_tpu.obs import WindowProfiler
 
-    # compile + warm-up on a short horizon
-    st = init()
-    jax.block_until_ready(run(st, jnp.int64(1 * SECOND)))
+    prof = WindowProfiler()
+    with prof.phase("build"):
+        eng, init = phold.build(
+            N_HOSTS,
+            capacity=capacity,
+            latency_ns=seconds(LATENCY_S),
+            mean_delay_ns=seconds(MEAN_DELAY_S),
+            msgs_per_host=MSGS_PER_HOST,
+            seed=SEED,
+            hot_hosts=hot_hosts,
+            hot_weight=hot_weight,
+            batched=batched,
+        )
+        run = jax.jit(eng.run)
+
+        # compile + warm-up on a short horizon
+        st = init()
+        jax.block_until_ready(run(st, jnp.int64(1 * SECOND)))
 
     # measure, with a timing-sanity retry: a degraded accelerator tunnel
     # has been observed to ack completion in ~0.3ms for work that takes
@@ -133,8 +137,9 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
     for _ in range(3):
         st = init()
         t0 = time.perf_counter()
-        st = run(st, jnp.int64(stop_s * SECOND))
-        executed = int(jax.device_get(st.stats.n_executed).sum())
+        with prof.phase("step"):
+            st = run(st, jnp.int64(stop_s * SECOND))
+            executed = int(jax.device_get(st.stats.n_executed).sum())
         wall = time.perf_counter() - t0
         if wall > 0.05:
             break
@@ -158,6 +163,12 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
         "device": str(dev.device_kind),
         "n_hosts": N_HOSTS,
         "drain": "batched" if batched else "sequential",
+        # per-phase wall breakdown (obs.WindowProfiler): how much of the
+        # stage went to build+compile vs measured device execution
+        "profile": {
+            name: round(p["total_s"], 3)
+            for name, p in prof.summary()["phases"].items()
+        },
     }
 
 
@@ -544,6 +555,7 @@ def main():
         "drain": r["drain"],
         "suspect_timing": r.get("suspect_timing", False),
         "device": r["device"],
+        "profile": r.get("profile", {}),
     }
     print(json.dumps(out), flush=True)
 

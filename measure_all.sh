@@ -22,4 +22,16 @@ run btc       1800 --btc-worker
 run phold     900  --phold-worker    BENCH_STOP_S=20
 run phold16k  1200 --phold-big-worker BENCH_STOP_S=20
 run skew      900  --skew-worker
+# fast observability smoke: a short traced+profiled run through the CLI
+# plus the Chrome-trace exporter; only the summary JSON line joins $R
+# (stderr notes and heartbeat lines go to the stamp log)
+echo "=== trace_smoke start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"trace_smoke\"}" >> "$R"
+timeout 600 python -m shadow_tpu --test --stoptime 5 \
+  --heartbeat-frequency 2 --trace 4096 --profile \
+  --trace-out measure_trace.npz > measure_trace.out 2>> "$S" \
+  && tail -n 1 measure_trace.out >> "$R" \
+  && timeout 120 python -m shadow_tpu.tools.export_trace \
+       measure_trace.npz -o measure_trace.json 2>> "$S"
+echo "=== trace_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 echo ALL_DONE >> "$S"

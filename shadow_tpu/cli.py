@@ -138,11 +138,28 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--diag-dir", default=".",
                    help="directory for watchdog stall bundles and stack "
                         "dumps")
+    p.add_argument("--trace", nargs="?", const=2048, type=int, default=0,
+                   metavar="N",
+                   help="device-side event tracing: record every executed "
+                        "event and routed send into a per-host ring of N "
+                        "records (bare --trace = 2048), drained at "
+                        "heartbeat boundaries and written to --trace-out; "
+                        "export to Chrome trace-event JSON with "
+                        "tools/export_trace.py (docs/8-Tracing-Profiling.md)")
+    p.add_argument("--trace-out", default="shadow_tpu.trace.npz",
+                   metavar="PATH",
+                   help="trace output file (.npz of record arrays + meta)")
+    p.add_argument("--profile", action="store_true",
+                   help="wall-clock-time the run loop's phases (build, "
+                        "jitted step, host drain, shim pump, checkpoint) "
+                        "plus per-window occupancy; adds a 'profile' key "
+                        "to the summary line and per-phase tracks to the "
+                        "exported trace")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
 
-def _make_observability(cfg, sim, args):
+def _make_observability(cfg, sim, args, trace=None):
     """Logger + tracker honoring the config's per-host loglevel and
     heartbeatloginfo attrs (tracker.c:433-561; shadow_logger.c:102-121)."""
     from shadow_tpu.config import expand_hosts
@@ -164,9 +181,22 @@ def _make_observability(cfg, sim, args):
             level_of[h.name] = h.spec.heartbeatloglevel
     tracker = Tracker(
         sim.names, logger, log_info=("node",), info_of=info_of,
-        level_of=level_of, faults=sim.faults,
+        level_of=level_of, faults=sim.faults, trace=trace,
     )
     return logger, tracker
+
+
+def _make_profiler(args):
+    """WindowProfiler when --profile, else None — plus a phase context
+    factory that degrades to a no-op so call sites stay unconditional."""
+    import contextlib
+
+    if not args.profile:
+        return None, (lambda _name: contextlib.nullcontext())
+    from shadow_tpu.obs import WindowProfiler
+
+    prof = WindowProfiler()
+    return prof, prof.phase
 
 
 def main(argv=None) -> int:
@@ -246,15 +276,17 @@ def main(argv=None) -> int:
             from shadow_tpu.parallel.mesh import make_mesh
 
             tier_mesh = make_mesh(args.mesh, dcn_slices=args.dcn_slices)
-        tier = ProcessTier(
-            cfg, seed=args.seed, n_sockets=args.sockets,
-            capacity=args.capacity,
-            strict_overflow=not args.allow_queue_overflow,
-            tcp_cc=args.tcp_congestion_control,
-            rx_queue=args.router_queue, qdisc=args.interface_qdisc,
-            interface_buffer=args.interface_buffer, mesh=tier_mesh,
-            locality=args.locality,
-        )
+        prof, _phase = _make_profiler(args)
+        with _phase("build"):
+            tier = ProcessTier(
+                cfg, seed=args.seed, n_sockets=args.sockets,
+                capacity=args.capacity,
+                strict_overflow=not args.allow_queue_overflow,
+                tcp_cc=args.tcp_congestion_control,
+                rx_queue=args.router_queue, qdisc=args.interface_qdisc,
+                interface_buffer=args.interface_buffer, mesh=tier_mesh,
+                locality=args.locality, trace=args.trace, profiler=prof,
+            )
         sup = Supervisor(
             watchdog_timeout=args.watchdog, diag_dir=args.diag_dir,
             label="shadow_tpu.proc",
@@ -286,6 +318,27 @@ def main(argv=None) -> int:
             )),
             "queue_drops": int(jax.device_get(st.queues.drops.sum())),
         }
+        if args.trace and st.trace is not None:
+            from shadow_tpu.obs import TraceDrain
+
+            tdrain = TraceDrain(
+                args.trace, names=tier.sim.names,
+                kind_names=list(tier.sim.kind_names),
+            )
+            tdrain.drain(st.trace)
+            tdrain.save(
+                args.trace_out,
+                profile=prof.export() if prof is not None else None,
+                extra_meta={"seed": args.seed, "tier": "process"},
+            )
+            summary["trace"] = {
+                "records": tdrain.n_records, "lost": tdrain.lost,
+                "truncated": tdrain.truncated, "file": args.trace_out,
+            }
+            print(f"event trace: {tdrain.n_records} records -> "
+                  f"{args.trace_out}", file=sys.stderr)
+        if prof is not None:
+            summary["profile"] = prof.summary()
         print(json.dumps(summary))
         if sup.stop_requested:
             print(f"interrupted by signal {sup.stop_signum}; the process "
@@ -303,18 +356,31 @@ def main(argv=None) -> int:
         from shadow_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(args.mesh, dcn_slices=args.dcn_slices)
-    sim = build_simulation(
-        cfg, seed=args.seed, n_sockets=args.sockets, capacity=args.capacity,
-        mesh=mesh, tcp_cc=args.tcp_congestion_control,
-        rx_queue=args.router_queue, qdisc=args.interface_qdisc,
-        interface_buffer=args.interface_buffer, locality=args.locality,
-        runahead_ns=(
-            int(args.runahead * MILLISECOND)
-            if args.runahead is not None else None
-        ),
-    )
+    prof, _phase = _make_profiler(args)
+    with _phase("build"):
+        sim = build_simulation(
+            cfg, seed=args.seed, n_sockets=args.sockets,
+            capacity=args.capacity,
+            mesh=mesh, tcp_cc=args.tcp_congestion_control,
+            rx_queue=args.router_queue, qdisc=args.interface_qdisc,
+            interface_buffer=args.interface_buffer, locality=args.locality,
+            runahead_ns=(
+                int(args.runahead * MILLISECOND)
+                if args.runahead is not None else None
+            ),
+            trace=args.trace, profiler=prof,
+        )
     if args.allow_queue_overflow:
         sim.strict_overflow = False
+    tdrain = None
+    if args.trace:
+        from shadow_tpu.obs import TraceDrain
+
+        tdrain = TraceDrain(
+            args.trace, names=sim.names, kind_names=list(sim.kind_names)
+        )
+        print(f"event trace: {args.trace} records/host/interval -> "
+              f"{args.trace_out}", file=sys.stderr)
     n_hosts = len(sim.names)
     print(f"shadow_tpu {__version__}: {n_hosts} hosts, "
           f"{sim.topo.n_vertices} topology vertices, "
@@ -393,7 +459,7 @@ def main(argv=None) -> int:
     ck = args.checkpoint_interval
     next_hb = (math.floor(sim_s / hb) + 1) * hb if hb > 0 else float("inf")
     next_ckpt = (math.floor(sim_s / ck) + 1) * ck if ck > 0 else float("inf")
-    logger, tracker = _make_observability(cfg, sim, args)
+    logger, tracker = _make_observability(cfg, sim, args, trace=tdrain)
     drain = None
     if sim.pcap_gids:
         from shadow_tpu.utils.pcap import CaptureDrain
@@ -421,12 +487,13 @@ def main(argv=None) -> int:
         # emergency checkpoints go to an explicit side path, NOT into
         # the rotation: a crashing run must never push the last known
         # good generation off the retention horizon
-        save_checkpoint(
-            path or args.checkpoint_path, st,
-            meta={"sim_seconds": sim_s, "seed": args.seed,
-                  "config_digest": cfg_digest, **extra_meta},
-            keep=1 if path else args.checkpoint_keep,
-        )
+        with _phase("checkpoint"):
+            save_checkpoint(
+                path or args.checkpoint_path, st,
+                meta={"sim_seconds": sim_s, "seed": args.seed,
+                      "config_digest": cfg_digest, **extra_meta},
+                keep=1 if path else args.checkpoint_keep,
+            )
         sup_hb.checkpoint_written()
 
     last_validated_windows = 0
@@ -450,12 +517,27 @@ def main(argv=None) -> int:
                         st, prev_now=prev_validated_now
                     )
                     last_validated_windows = summary_now["windows"]
+                if prof is not None:
+                    from shadow_tpu.obs import queue_fill
+
+                    prof.observe(
+                        summary_now, queue_fill=queue_fill(st),
+                        stall_margin_s=(
+                            sup.watchdog.margin_s()
+                            if sup.watchdog is not None else None
+                        ),
+                    )
                 if sim_s >= next_hb:
-                    tracker.heartbeat(st, int(sim_s * SECOND))
-                    sup_hb.beat(int(sim_s * SECOND), summary_now)
-                    logger.flush()
-                    if drain is not None:
-                        drain.drain(st.hosts.net.cap)
+                    with _phase("drain"):
+                        # trace first: the tracker's [trace] section
+                        # consumes the drain's interval counts
+                        if tdrain is not None:
+                            st = tdrain.drain_state(st)
+                        tracker.heartbeat(st, int(sim_s * SECOND))
+                        sup_hb.beat(int(sim_s * SECOND), summary_now)
+                        logger.flush()
+                        if drain is not None:
+                            drain.drain(st.hosts.net.cap)
                     next_hb += hb
                 if sup.take_checkpoint_request():  # SIGUSR1
                     write_checkpoint(on_demand=True)
@@ -491,8 +573,8 @@ def main(argv=None) -> int:
         raise
     finally:
         # interrupted and failed runs keep their observability output:
-        # flush buffered log lines and close every pcap writer so the
-        # on-disk captures are valid up to the last drain
+        # flush buffered log lines, close every pcap writer, and write
+        # the trace file so captures are valid up to the last drain
         logger.flush()
         if drain is not None:
             try:
@@ -504,6 +586,22 @@ def main(argv=None) -> int:
                 print(f"pcap: {drain.lost} records lost to ring overrun "
                       "(raise --heartbeat-frequency cadence)",
                       file=sys.stderr)
+        if tdrain is not None:
+            try:
+                st = tdrain.drain_state(st)
+            except Exception:
+                pass
+            tdrain.save(
+                args.trace_out,
+                profile=prof.export() if prof is not None else None,
+                extra_meta={"seed": args.seed, "tier": "device"},
+            )
+            print(f"event trace: {tdrain.n_records} records -> "
+                  f"{args.trace_out}"
+                  + (f" ({tdrain.lost} lost to ring overrun; raise "
+                     "--trace N or the heartbeat cadence)"
+                     if tdrain.lost else ""),
+                  file=sys.stderr)
     wall = time.perf_counter() - t1
     if sup.stop_requested:
         print(f"interrupted by signal {sup.stop_signum}: checkpoint at "
@@ -553,6 +651,13 @@ def main(argv=None) -> int:
         summary["packet_stages"] = {
             k: v for k, v in drain.stage_counts.items() if v
         }
+    if tdrain is not None:
+        summary["trace"] = {
+            "records": tdrain.n_records, "lost": tdrain.lost,
+            "truncated": tdrain.truncated, "file": args.trace_out,
+        }
+    if prof is not None:
+        summary["profile"] = prof.summary()
     print(json.dumps(summary))
     return 0
 

@@ -189,6 +189,11 @@ class EngineState:
     # re-templating, bandwidth rescales) have been applied; always 0
     # when no fault schedule is configured
     fault_epoch: jax.Array  # i32[] (replicated)
+    # device-side event-trace ring (shadow_tpu.obs.trace.TraceRing) or
+    # None when EngineConfig.trace == 0 — None contributes zero pytree
+    # leaves, keeping the compiled program and checkpoint layout
+    # identical to a trace-free build
+    trace: Any = None
 
 
 def state_summary(state: EngineState) -> dict:
@@ -231,6 +236,15 @@ class EngineConfig:
     drain_batch: int = 32  # B: frontier events extracted per host per sweep
     route_bucket: int = 0  # per-peer all_to_all bucket slots (0 = auto)
     stage_width: int = 0  # staging slots per host (0 = auto: B + 4K)
+    # Device-side event tracing (shadow_tpu.obs.trace): records per host
+    # the ring holds between drains. 0 (the default) compiles the trace
+    # path away entirely — EngineState.trace is None (a leaf-free pytree
+    # subtree), so the jitted program and the checkpoint leaf list are
+    # identical to a trace-free build.
+    trace: int = 0
+    # args column holding the payload-length word for trace records
+    # (A_LEN for the packet stack; harmless 0 for bare-engine models)
+    trace_len_arg: int = 0
     # Burst delivery: fold contiguous same-flow packet arrivals staged in
     # one sweep into a single multi-segment event — the chained drain's
     # sequential depth is the busiest host's event count, and TCP data
@@ -268,6 +282,13 @@ class EngineConfig:
         if self.route_bucket < 0:
             raise ValueError(
                 f"route_bucket must be >= 0, got {self.route_bucket}"
+            )
+        if self.trace < 0:
+            raise ValueError(f"trace must be >= 0, got {self.trace}")
+        if not 0 <= self.trace_len_arg < self.n_args:
+            raise ValueError(
+                f"trace_len_arg {self.trace_len_arg} outside "
+                f"[0, {self.n_args})"
             )
         if self.burst is not None and self.eff_stage_width > BURST_NSEG_MAX:
             # the fold packs its run count into bits 24..30 of the
@@ -397,6 +418,9 @@ class Engine:
         # jitter rolls cost an extra uniform per emit row; skip them
         # entirely for jitter-free networks
         self._use_jitter = bool(getattr(network, "has_jitter", False))
+        # device-side event tracing: a static flag like the CPU/jitter
+        # paths — trace=0 builds carry no ring and compile no appends
+        self._trace = cfg.trace > 0
         # fault schedule: static sub-flags keep the no-fault (and
         # partial-fault) compiled programs free of dead overlay work
         self.faults = faults
@@ -507,6 +531,17 @@ class Engine:
         return q, rounds, n_cross
 
     # -- state construction -------------------------------------------------
+    def _trace_slack(self) -> int:
+        """Scratch columns past the trace ring's capacity: the widest
+        single append either drain path performs, so full-ring overflow
+        writes always land in the never-read zone (obs.trace docstring).
+        """
+        k = self.cfg.max_emit
+        if self.batch_handler is not None:
+            b = max(1, min(self.cfg.drain_batch, self.cfg.capacity))
+            return b * (1 + k)
+        return 1 + k
+
     def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
         cfg = self.cfg
         q = EventQueue.create(cfg.n_hosts, cfg.capacity, cfg.n_args)
@@ -522,6 +557,13 @@ class Engine:
             jnp.where(valid & (local_src >= 0) & (local_src < cfg.n_hosts),
                       local_src, cfg.n_hosts)
         ].max(flat.seq + 1, mode="drop")
+        trace = None
+        if self._trace:
+            from shadow_tpu.obs.trace import TraceRing
+
+            trace = TraceRing.create(
+                cfg.n_hosts, cfg.trace, self._trace_slack()
+            )
         return EngineState(
             now=jnp.zeros((), jnp.int64),
             queues=q,
@@ -531,6 +573,7 @@ class Engine:
             stats=Stats.create(cfg.n_hosts, len(self.handlers)),
             cpu_free=jnp.zeros((cfg.n_hosts,), jnp.int64),
             fault_epoch=jnp.zeros((), jnp.int32),
+            trace=trace,
         )
 
     # -- fault-schedule helpers ---------------------------------------------
@@ -640,11 +683,14 @@ class Engine:
 
     # -- execute one frontier position across all hosts ---------------------
     def _execute_step(self, hosts, src_seq, exec_cnt, stats, ev: Events,
-                      active: jax.Array, window_end: jax.Array, gids: jax.Array):
+                      active: jax.Array, window_end: jax.Array,
+                      gids: jax.Array, trace=None):
         """Run handlers for one event per host (masked), route the emits.
 
         Returns (hosts', src_seq', exec_cnt', stats', routed Events[H, K],
-        final_mask[H, K]).
+        final_mask[H, K], trace'). `trace` passes through untouched
+        (None) unless tracing is compiled in, in which case one append
+        records the executed event plus every non-local emit.
         """
         cfg = self.cfg
         h, k = cfg.n_hosts, cfg.max_emit
@@ -678,6 +724,42 @@ class Engine:
             emit, ev.time, gids, window_end, rkeys, emask, seq
         )
 
+        if self._trace and trace is not None:
+            from shadow_tpu.obs.trace import (
+                OP_DROP, OP_EXEC, OP_FDROP, OP_SEND, trace_append,
+            )
+
+            la = cfg.trace_len_arg
+            # one width-(1+K) append: the executed event (op EXEC, on the
+            # executing host's row) + its non-local emits (op SEND, or
+            # DROP/FDROP with the loss attribution, on the source row at
+            # emission time — the matching EXEC on the destination row is
+            # the arrival, and (src, seq) ties the pair into a flow)
+            op_send = jnp.where(
+                dropped, OP_DROP,
+                jnp.where(fdropped, OP_FDROP, OP_SEND),
+            ).astype(jnp.int32)
+            col = lambda a: a[:, None]
+            trace = trace_append(
+                trace, cfg.trace,
+                time=jnp.concatenate(
+                    [col(ev.time), jnp.broadcast_to(col(ev.time), (h, k))], 1
+                ),
+                src=jnp.concatenate([col(ev.src), out.src], 1),
+                dst=jnp.concatenate([col(ev.dst), out.dst], 1),
+                kind=jnp.concatenate([col(ev.kind), out.kind], 1),
+                plen=jnp.concatenate(
+                    [ev.args[:, la:la + 1], out.args[:, :, la]], 1
+                ),
+                seq=jnp.concatenate([col(ev.seq), out.seq], 1),
+                op=jnp.concatenate(
+                    [jnp.full((h, 1), OP_EXEC, jnp.int32), op_send], 1
+                ),
+                mask=jnp.concatenate(
+                    [col(active), emask & ~_is_local], 1
+                ),
+            )
+
         exec_cnt = exec_cnt + active.astype(jnp.int32)
         stats = dataclasses.replace(
             stats,
@@ -694,7 +776,7 @@ class Engine:
                 * active[:, None]
             ),
         )
-        return hosts, src_seq, exec_cnt, stats, out, final_mask
+        return hosts, src_seq, exec_cnt, stats, out, final_mask, trace
 
     # -- commutative fast path: whole frontiers in one vmapped call ---------
     def _drain_window_batched(self, st: EngineState, window_end, host0):
@@ -718,7 +800,7 @@ class Engine:
             return self._gany(jnp.any(nxt < window_end))
 
         def outer_body(carry):
-            q, hosts, src_seq, exec_cnt, stats, cpu_free = carry
+            q, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
             bt = q.time[:, :b]
             # a host whose virtual CPU is busy past the barrier runs
             # nothing this window (whole-frontier granularity)
@@ -776,6 +858,44 @@ class Engine:
                 flat(seq),
             )
 
+            if self._trace and trace is not None:
+                from shadow_tpu.obs.trace import (
+                    OP_DROP, OP_EXEC, OP_FDROP, OP_SEND, trace_append,
+                )
+
+                la = cfg.trace_len_arg
+                # one width-(B + B*K) append per sweep: the executed
+                # frontier (EXEC rows) + every non-local emit
+                # (SEND/DROP/FDROP rows) — same semantics as the chained
+                # path's per-step append in _execute_step
+                wide = lambda a: a.reshape(h, b * k)  # [H*B, K] -> [H, BK]
+                op_send = jnp.where(
+                    dropped, OP_DROP,
+                    jnp.where(fdropped, OP_FDROP, OP_SEND),
+                ).astype(jnp.int32)
+                send_t = jnp.broadcast_to(
+                    evs.time[:, :, None], (h, b, k)
+                ).reshape(h, b * k)
+                trace = trace_append(
+                    trace, cfg.trace,
+                    time=jnp.concatenate([evs.time, send_t], 1),
+                    src=jnp.concatenate([evs.src, wide(out.src)], 1),
+                    dst=jnp.concatenate([evs.dst, wide(out.dst)], 1),
+                    kind=jnp.concatenate([evs.kind, wide(out.kind)], 1),
+                    plen=jnp.concatenate(
+                        [evs.args[:, :, la],
+                         out.args[:, :, la].reshape(h, b * k)], 1
+                    ),
+                    seq=jnp.concatenate([evs.seq, wide(out.seq)], 1),
+                    op=jnp.concatenate(
+                        [jnp.full((h, b), OP_EXEC, jnp.int32),
+                         wide(op_send)], 1
+                    ),
+                    mask=jnp.concatenate(
+                        [run, wide(flat(emask) & ~_loc)], 1
+                    ),
+                )
+
             exec_cnt = exec_cnt + n_exec
             stats2 = dataclasses.replace(
                 stats,
@@ -830,13 +950,12 @@ class Engine:
                 n_xchg_rounds=stats2.n_xchg_rounds + xr,
                 n_cross_shard=stats2.n_cross_shard + nc,
             )
-            return (q, hosts, src_seq, exec_cnt, stats2, cpu_free)
+            return (q, hosts, src_seq, exec_cnt, stats2, cpu_free, trace)
 
         carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats,
-                 st.cpu_free)
-        q, hosts, src_seq, exec_cnt, stats, cpu_free = jax.lax.while_loop(
-            outer_cond, outer_body, carry
-        )
+                 st.cpu_free, st.trace)
+        (q, hosts, src_seq, exec_cnt, stats, cpu_free,
+         trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
         return dataclasses.replace(
             st,
             queues=q,
@@ -845,6 +964,7 @@ class Engine:
             exec_cnt=exec_cnt,
             stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
             cpu_free=cpu_free,
+            trace=trace,
         )
 
     # -- staging-buffer helpers (chained drain) ------------------------------
@@ -1056,7 +1176,7 @@ class Engine:
             return self._gany(jnp.any(nxt < window_end))
 
         def outer_body(carry):
-            q, hosts, src_seq, exec_cnt, stats, cpu_free = carry
+            q, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
 
             # 1. move the frontier into staging: queue rows are sorted by
             # (time, src, seq) with empties last (events.py invariant), so
@@ -1133,7 +1253,8 @@ class Engine:
                 return ic[0]
 
             def inner_body(ic):
-                _, sm, stage, hosts, src_seq, exec_cnt, stats, cpu_free = ic
+                (_, sm, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
+                 trace) = ic
                 ev, mss, onehot, cnt = sm
                 ev_t = ev.time
                 eff_t = (
@@ -1170,10 +1291,10 @@ class Engine:
                     time=jnp.where(runm, eff_t, TIME_INVALID),
                     dst=gids,
                 )
-                hosts, src_seq, exec_cnt, stats, out, _fmask = (
+                hosts, src_seq, exec_cnt, stats, out, _fmask, trace = (
                     self._execute_step(
                         hosts, src_seq, exec_cnt, stats, ev, runm,
-                        window_end, gids,
+                        window_end, gids, trace,
                     )
                 )
                 if self._cpu_enabled:
@@ -1202,15 +1323,15 @@ class Engine:
                 )
                 sm2 = self._stage_min(stage)
                 return (can_run(sm2, cpu_free), sm2, stage, hosts, src_seq,
-                        exec_cnt, stats, cpu_free)
+                        exec_cnt, stats, cpu_free, trace)
 
             sm0 = self._stage_min(stage)
-            (_, _, stage, hosts, src_seq, exec_cnt, stats,
-             cpu_free) = jax.lax.while_loop(
+            (_, _, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
+             trace) = jax.lax.while_loop(
                 inner_cond,
                 inner_body,
                 (can_run(sm0, cpu_free), sm0, stage, hosts, src_seq,
-                 exec_cnt, stats, cpu_free),
+                 exec_cnt, stats, cpu_free, trace),
             )
 
             # 3. flush staging leftovers (clamped remote sends, far-future
@@ -1270,13 +1391,12 @@ class Engine:
                 n_xchg_rounds=stats.n_xchg_rounds + xr,
                 n_cross_shard=stats.n_cross_shard + nc,
             )
-            return (q, hosts, src_seq, exec_cnt, stats, cpu_free)
+            return (q, hosts, src_seq, exec_cnt, stats, cpu_free, trace)
 
         carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats,
-                 st.cpu_free)
-        q, hosts, src_seq, exec_cnt, stats, cpu_free = jax.lax.while_loop(
-            outer_cond, outer_body, carry
-        )
+                 st.cpu_free, st.trace)
+        (q, hosts, src_seq, exec_cnt, stats, cpu_free,
+         trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
         # each shard's inner loop trips independently; fold this window's
         # delta across shards so the counter stays replicated-consistent
         inner = st.stats.n_inner_steps + self._gsum(
@@ -1292,6 +1412,7 @@ class Engine:
                 stats, n_windows=stats.n_windows + 1, n_inner_steps=inner
             ),
             cpu_free=cpu_free,
+            trace=trace,
         )
 
     def _next_time(self, st: EngineState) -> jax.Array:

@@ -138,6 +138,7 @@ def build(
     # with H*drain_batch -- 25% smaller than the engine's general default
     drain_batch: int = 24,
     batched: bool = False,
+    trace: int = 0,
 ):
     """Build (engine, initial_state) for an n_hosts PHOLD network.
 
@@ -156,6 +157,7 @@ def build(
         axis_name=axis_name,
         n_shards=n_shards,
         drain_batch=drain_batch,
+        trace=trace,
     )
     net = ConstantNetwork(latency_ns)
     eng = Engine(

@@ -1,0 +1,29 @@
+"""Observability: device-side event tracing + window-phase profiling.
+
+Two halves, deliberately decoupled:
+
+- `trace`: an on-device ring buffer (`TraceRing`) that the engine's
+  jitted window loop appends per-event records into under a static
+  `EngineConfig.trace` flag, plus the host-side `TraceDrain` that
+  empties it at heartbeat boundaries and accumulates records for the
+  Chrome-trace exporter (`shadow_tpu.tools.export_trace`).
+- `profiler`: a host-side wall-clock phase timer (`WindowProfiler`)
+  for the un-jitted skeleton of the run loop (build, jitted step, host
+  drain, shim pump, checkpoint) plus per-window occupancy sampling.
+
+Neither half costs anything when off: the trace ring is `None` in
+`EngineState` (zero pytree leaves — identical compiled program,
+identical checkpoint leaf list), and the profiler is simply absent.
+"""
+
+from shadow_tpu.obs.trace import (  # noqa: F401
+    OP_DROP,
+    OP_EXEC,
+    OP_FDROP,
+    OP_NAMES,
+    OP_SEND,
+    TraceDrain,
+    TraceRing,
+    trace_append,
+)
+from shadow_tpu.obs.profiler import WindowProfiler, queue_fill  # noqa: F401

@@ -95,7 +95,8 @@ class ProcessTier:
                  strict_overflow: bool = True, tcp_cc: str = "reno",
                  rx_queue: str = "codel", qdisc: str = "fifo",
                  interface_buffer: int = 1_024_000, mesh=None,
-                 driver_slots: int | None = None, locality: bool = False):
+                 driver_slots: int | None = None, locality: bool = False,
+                 trace: int = 0, profiler=None):
         self.strict_overflow = strict_overflow
         self.model = ProcTierModel()
         # hard slot-space split: device-created children live in
@@ -114,6 +115,7 @@ class ProcessTier:
             app_model=self.model, tcp_cc=tcp_cc, rx_queue=rx_queue,
             qdisc=qdisc, interface_buffer=interface_buffer, mesh=mesh,
             tcp_child_slot_limit=self._child_limit, locality=locality,
+            trace=trace, profiler=profiler,
         )
         self.rt = ShimRuntime()
         self.rt.set_seed(seed)  # roots plugin rand()/urandom determinism
@@ -794,7 +796,11 @@ class ProcessTier:
                     )
                 comps.append((pid, COMP_TIMER, fd, int(n_exp if interval > 0 else 1), gen))
 
-            reqs = self.rt.pump(now, comps)
+            if self.sim.profiler is not None:
+                with self.sim.profiler.phase("pump"):
+                    reqs = self.rt.pump(now, comps)
+            else:
+                reqs = self.rt.pump(now, comps)
             st = self._inject(st, self._translate(reqs, now), now)
             if supervisor is not None:
                 supervisor.pet(

@@ -138,6 +138,11 @@ class Simulation:
     pcap_dir: str = "shadow.pcap.d"  # from the pcapdir host attr
     kind_names: tuple = ()  # handler-kind names (object-counter labels)
     faults: Any = None  # CompiledFaults when the config schedules any
+    # WindowProfiler (shadow_tpu.obs) when built with profiling on: the
+    # jitted step phase is timed here (the un-jitted skeleton around it —
+    # drains, pump, checkpoints — is timed by the CLI / process tier),
+    # and summary() grows a "profile" key
+    profiler: Any = None
 
     _jit_run: Any = None
     _jit_step: Any = None
@@ -198,7 +203,12 @@ class Simulation:
             object.__setattr__(self, "_jit_run", self._wrap(self.engine.run))
         st = state if state is not None else self.state0
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
-        out = self._jit_run(st, stop)
+        if self.profiler is not None:
+            with self.profiler.phase("step"):
+                out = self._jit_run(st, stop)
+                out.now.block_until_ready()
+        else:
+            out = self._jit_run(st, stop)
         if self.strict_overflow:
             drops = int(jax.device_get(out.queues.drops.sum()))
             if drops > 0:
@@ -216,16 +226,26 @@ class Simulation:
                 self, "_jit_step", self._wrap(self.engine.step_window)
             )
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
+        if self.profiler is not None:
+            with self.profiler.phase("step"):
+                out = self._jit_step(state, stop)
+                out.now.block_until_ready()
+            return out
         return self._jit_step(state, stop)
 
     def summary(self, state) -> dict:
         """Host-side progress snapshot (frontier time, window count,
         executed events) — what the supervised run loop pets its
         watchdog with and the stall bundle records; see
-        core.engine.state_summary."""
+        core.engine.state_summary. With a profiler attached, grows a
+        "profile" key (wall-clock phase aggregates + occupancy —
+        stripped from determinism diffs by tools/strip_log.py)."""
         from shadow_tpu.core.engine import state_summary
 
-        return state_summary(state)
+        out = state_summary(state)
+        if self.profiler is not None:
+            out["profile"] = self.profiler.summary()
+        return out
 
 
 def _plugin_tokens(cfg: ShadowConfig, plugin_id: str) -> set[str]:
@@ -428,6 +448,8 @@ def build_simulation(
     fuse_rx: bool = True,
     burst_rx: bool = True,
     shape_bucket: bool = True,
+    trace: int = 0,
+    profiler: Any = None,
 ) -> Simulation:
     """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
 
@@ -787,10 +809,13 @@ def build_simulation(
         burst = (KIND_PKT_ARRIVE, A_SEQ, A_LEN, A_SPORT, A_DPORT, A_META,
                  int(PROTO_TCP), int(F_SYN | F_FIN | F_RST), int(MSS),
                  (A_ACK, A_WND, A_AUX, A_SACK0, A_SACK1))
+    from shadow_tpu.transport.stack import A_LEN as _A_LEN
+
     ecfg = EngineConfig(
         n_hosts=per_shard, capacity=capacity, lookahead=lookahead,
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
         axis_name=axis_name, n_shards=n_shards, burst=burst,
+        trace=int(trace), trace_len_arg=int(_A_LEN),
     )
     network = topo.build_network(host_vertex)
     # per-KIND CPU charges: a model may declare cycle costs for specific
@@ -933,6 +958,7 @@ def build_simulation(
         pcap_dir=(pcap_dirs.pop() if pcap_dirs else "shadow.pcap.d"),
         kind_names=tuple(kind_names),
         faults=faults,
+        profiler=profiler,
     )
 
 

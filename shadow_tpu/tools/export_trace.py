@@ -1,0 +1,172 @@
+"""Export a shadow_tpu event trace to Chrome trace-event JSON.
+
+Reads the .npz written by --trace (obs.trace.TraceDrain.save) and emits
+the Trace Event Format consumed by Perfetto (ui.perfetto.dev) and
+chrome://tracing:
+
+- **pid 0 "sim time"** — one thread track per host (tid = gid, named by
+  host name). Every trace record becomes an instant event at its
+  simulated time; send->receive deliveries are joined with flow arrows
+  ("s" on the OP_SEND record at the source, "f" on the matching OP_EXEC
+  record at the destination, id = src<<32 | seq).
+- **pid 1 "wall clock"** — one thread track per run-loop phase (build /
+  step / drain / pump / checkpoint), "X" complete-spans from the
+  --profile WindowProfiler, relative to profiler start.
+
+Timestamps are microseconds (the format's unit): sim nanoseconds /1e3,
+wall seconds *1e6. Output is deterministic for a deterministic trace —
+records arrive pre-sorted by (time, src, seq, op, dst) and keys are
+emitted in a fixed order — so repeat-run exports diff byte for byte.
+
+    python -m shadow_tpu.tools.export_trace shadow_tpu.trace.npz
+    python -m shadow_tpu.tools.export_trace run.npz -o run.trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from shadow_tpu.obs.trace import OP_DROP, OP_EXEC, OP_FDROP, OP_SEND
+
+
+def _meta_event(pid: int, tid: int, what: str, name: str) -> dict:
+    return {"ph": "M", "pid": pid, "tid": tid, "name": what,
+            "args": {"name": name}}
+
+
+def build_events(recs: dict, meta: dict) -> list[dict]:
+    """Pure transform: (records, meta) -> Chrome trace event list."""
+    names = meta.get("names") or []
+    kind_names = meta.get("kind_names") or []
+    op_names = meta.get("op_names") or ["exec", "send", "drop", "fault_drop"]
+    host = lambda g: names[g] if 0 <= g < len(names) else f"host{g}"
+    kind = lambda k: (
+        kind_names[k] if 0 <= k < len(kind_names) else f"kind{k}"
+    )
+
+    events: list[dict] = [
+        _meta_event(0, 0, "process_name", "sim time"),
+        _meta_event(1, 0, "process_name", "wall clock"),
+    ]
+    n = int(recs["time"].shape[0])
+    owners = sorted({int(o) for o in recs["owner"][:n]})
+    for g in owners:
+        events.append(_meta_event(0, g, "thread_name", host(g)))
+
+    # flow targets: the OP_EXEC record of a delivered send lives on the
+    # destination host and keeps the sender's (src, seq) identity
+    exec_at: dict[tuple[int, int, int], int] = {}
+    for i in range(n):
+        if int(recs["op"][i]) == OP_EXEC:
+            key = (int(recs["src"][i]), int(recs["seq"][i]),
+                   int(recs["owner"][i]))
+            exec_at.setdefault(key, i)
+
+    def row(i: int) -> dict:
+        return {
+            "time": int(recs["time"][i]), "src": int(recs["src"][i]),
+            "dst": int(recs["dst"][i]), "kind": int(recs["kind"][i]),
+            "plen": int(recs["plen"][i]), "seq": int(recs["seq"][i]),
+            "op": int(recs["op"][i]), "owner": int(recs["owner"][i]),
+        }
+
+    flows = 0
+    for i in range(n):
+        r = row(i)
+        ts = r["time"] / 1e3  # ns -> us
+        op = r["op"]
+        label = (
+            kind(r["kind"]) if op == OP_EXEC
+            else f"{op_names[op] if op < len(op_names) else op}:"
+                 f"{kind(r['kind'])}"
+        )
+        ev = {
+            "ph": "i", "pid": 0, "tid": r["owner"], "ts": ts,
+            "name": label, "s": "t",
+            "args": {"src": host(r["src"]), "dst": host(r["dst"]),
+                     "seq": r["seq"], "plen": r["plen"],
+                     "op": op_names[op] if op < len(op_names) else str(op)},
+        }
+        events.append(ev)
+        if op == OP_SEND:
+            j = exec_at.get((r["src"], r["seq"], r["dst"]))
+            if j is None:
+                continue  # in flight past stoptime, or exec record lost
+            fid = (r["src"] << 32) | r["seq"]
+            deliver = f"deliver:{kind(r['kind'])}"
+            events.append({
+                "ph": "s", "pid": 0, "tid": r["owner"], "ts": ts,
+                "id": fid, "name": deliver, "cat": "net",
+            })
+            events.append({
+                "ph": "f", "pid": 0, "tid": int(recs["owner"][j]),
+                "ts": int(recs["time"][j]) / 1e3, "id": fid,
+                "name": deliver, "cat": "net", "bp": "e",
+            })
+            flows += 1
+
+    profile = meta.get("profile") or {}
+    spans = profile.get("spans") or []
+    phase_tid = {}
+    for name, start, dur in spans:
+        if name not in phase_tid:
+            phase_tid[name] = len(phase_tid)
+            events.append(
+                _meta_event(1, phase_tid[name], "thread_name", name)
+            )
+        events.append({
+            "ph": "X", "pid": 1, "tid": phase_tid[name],
+            "ts": float(start) * 1e6, "dur": float(dur) * 1e6,
+            "name": name, "cat": "phase",
+        })
+    return events
+
+
+def export(in_path: str, out_path: str) -> dict:
+    """Convert one .npz trace file; returns stats for the caller."""
+    from shadow_tpu.obs.trace import load_trace
+
+    recs, meta = load_trace(in_path)
+    events = build_events(recs, meta)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            k: meta.get(k)
+            for k in ("n_records", "lost", "truncated", "seed", "tier")
+            if k in meta
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, separators=(",", ":"), sort_keys=True)
+        f.write("\n")
+    n_flows = sum(1 for e in events if e.get("ph") == "s")
+    return {"events": len(events), "flows": n_flows,
+            "records": meta.get("n_records", 0), "out": out_path}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="export_trace",
+        description="shadow_tpu trace .npz -> Chrome trace-event JSON "
+                    "(load in ui.perfetto.dev or chrome://tracing)",
+    )
+    p.add_argument("trace", help=".npz written by shadow_tpu --trace")
+    p.add_argument("-o", "--out", default=None,
+                   help="output JSON path (default: <trace>.json)")
+    args = p.parse_args(argv)
+    out = args.out or (
+        args.trace[:-4] + ".json" if args.trace.endswith(".npz")
+        else args.trace + ".json"
+    )
+    stats = export(args.trace, out)
+    print(f"wrote {stats['events']} trace events "
+          f"({stats['records']} records, {stats['flows']} flow pairs) "
+          f"-> {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -35,11 +35,33 @@ RAM_FIELDS = (
     "sockets_capacity", "state_bytes",
 )
 
+# the [fault] section only appears when the run had a fault schedule;
+# downtime is fractional seconds, everything else integer counts
+FAULT_FIELDS = ("fault_drops", "quarantined_events", "downtime_seconds")
+
+# whole-run [supervisor] rows: wall rates + watchdog margin (the margin
+# column may be empty when no watchdog was armed)
+SUPERVISOR_FIELDS = (
+    "windows", "windows_per_sec", "events_per_sec",
+    "stall_margin_seconds", "checkpoints_written",
+)
+
+# exact per-host record counts from the --trace drain
+TRACE_FIELDS = (
+    "exec_records", "send_records", "net_drop_records",
+    "fault_drop_records", "lost_records",
+)
+
 
 def parse_lines(lines) -> dict:
     nodes: dict[str, dict] = {}
     sockets: dict[str, list] = {}
     ram: dict[str, dict] = {}
+    faults: dict[str, dict] = {}
+    trace: dict[str, dict] = {}
+    supervisor: dict[str, list] = {
+        "ticks": [], **{f: [] for f in SUPERVISOR_FIELDS}
+    }
     for line in lines:
         if "[shadow-heartbeat] [node] " in line:
             csv = line.rsplit("[shadow-heartbeat] [node] ", 1)[1].strip()
@@ -83,7 +105,46 @@ def parse_lines(lines) -> dict:
             node["ticks"].append(int(parts[0]))
             for f, v in zip(RAM_FIELDS, parts[2:]):
                 node[f].append(int(v))
-    return {"nodes": nodes, "sockets": sockets, "ram": ram}
+        elif "[shadow-heartbeat] [fault] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [fault] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 2 + len(FAULT_FIELDS):
+                continue
+            node = faults.setdefault(
+                parts[1], {"ticks": [], **{f: [] for f in FAULT_FIELDS}}
+            )
+            node["ticks"].append(int(parts[0]))
+            node["fault_drops"].append(int(parts[2]))
+            node["quarantined_events"].append(int(parts[3]))
+            node["downtime_seconds"].append(float(parts[4]))
+        elif "[shadow-heartbeat] [trace] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [trace] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 2 + len(TRACE_FIELDS):
+                continue
+            node = trace.setdefault(
+                parts[1], {"ticks": [], **{f: [] for f in TRACE_FIELDS}}
+            )
+            node["ticks"].append(int(parts[0]))
+            for f, v in zip(TRACE_FIELDS, parts[2:]):
+                node[f].append(int(v))
+        elif "[shadow-heartbeat] [supervisor] " in line:
+            csv = line.rsplit(
+                "[shadow-heartbeat] [supervisor] ", 1
+            )[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 1 + len(SUPERVISOR_FIELDS):
+                continue
+            supervisor["ticks"].append(int(parts[0]))
+            supervisor["windows"].append(int(parts[1]))
+            supervisor["windows_per_sec"].append(float(parts[2]))
+            supervisor["events_per_sec"].append(float(parts[3]))
+            supervisor["stall_margin_seconds"].append(
+                float(parts[4]) if parts[4] else None
+            )
+            supervisor["checkpoints_written"].append(int(parts[5]))
+    return {"nodes": nodes, "sockets": sockets, "ram": ram,
+            "faults": faults, "trace": trace, "supervisor": supervisor}
 
 
 def main(argv=None) -> int:

@@ -123,6 +123,57 @@ def make_figures(stats: dict, outdir: str, fmt: str = "png") -> list[str]:
     ax.set_title("simulation event rate")
     save(fig, "shadow_tpu.events")
 
+    # 5. fault impact timeline — only when the run had a fault schedule
+    # (the [fault] heartbeat section is conditional, so this figure is too)
+    faults = stats.get("faults", {})
+    if faults:
+        fig, (ax, ax2) = plt.subplots(
+            2, 1, figsize=(8, 6), sharex=True
+        )
+        for field, label, axis in (
+            ("fault_drops", "fault drops", ax),
+            ("quarantined_events", "quarantined events", ax),
+            ("downtime_seconds", "downtime (s)", ax2),
+        ):
+            totals = {}
+            for node in faults.values():
+                for t, d in zip(node.get("ticks", []),
+                                node.get(field, [])):
+                    totals[t] = totals.get(t, 0) + d
+            if totals:
+                xs = sorted(totals)
+                axis.plot(xs, [totals[x] for x in xs], label=label)
+        ax.set_ylabel("count / interval")
+        ax.set_title("fault impact")
+        ax.legend()
+        ax2.set_xlabel("sim time (s)")
+        ax2.set_ylabel("downtime (s) / interval")
+        save(fig, "shadow_tpu.faults")
+
+    # 6. supervisor progress — wall-clock window/event rates plus the
+    # watchdog stall margin (only for supervised runs that beat)
+    sup = stats.get("supervisor", {})
+    if sup.get("ticks"):
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        xs = sup["ticks"]
+        ax.plot(xs, sup.get("events_per_sec", []), label="events/s (wall)")
+        ax.plot(xs, sup.get("windows_per_sec", []), label="windows/s (wall)")
+        margins = [
+            (t, m) for t, m in zip(xs, sup.get("stall_margin_seconds", []))
+            if m is not None
+        ]
+        if margins:
+            ax2 = ax.twinx()
+            ax2.plot(*zip(*margins), color="tab:red", linestyle="--",
+                     label="stall margin (s)")
+            ax2.set_ylabel("watchdog margin (s)")
+        ax.set_xlabel("sim time (s)")
+        ax.set_ylabel("rate (wall)")
+        ax.set_yscale("symlog")
+        ax.set_title("supervisor progress")
+        ax.legend(loc="upper left")
+        save(fig, "shadow_tpu.supervisor")
+
     return written
 
 

@@ -20,12 +20,21 @@ import re
 import sys
 
 # wall-clock-derived summary fields (everything else in the summary is
-# simulation-determined and must be identical across repeat runs)
+# simulation-determined and must be identical across repeat runs);
+# "profile" is the --profile phase/occupancy report — wall timing
 _WALL_KEYS = {
     "wall_seconds", "build_seconds", "events_per_sec", "sim_s_per_wall_s",
+    "profile",
 }
 
 _HEX_ADDR = re.compile(r"0x[0-9a-fA-F]{6,}")
+# [supervisor] heartbeat rows mix sim-determined fields (time, windows,
+# checkpoints) with wall-clock rates and the watchdog stall margin;
+# blank out only the wall-derived columns so the rest still diffs
+_SUPERVISOR = re.compile(
+    r"(\[shadow-heartbeat\] \[supervisor\] \d+,\d+,)"
+    r"[0-9.]*,[0-9.]*,[0-9.]*(,\d+)$"
+)
 
 
 def strip_line(line: str) -> str | None:
@@ -43,6 +52,7 @@ def strip_line(line: str) -> str | None:
     # progress/timing diagnostics are wall-clock noise
     if "compile" in s and "second" in s:
         return None
+    s = _SUPERVISOR.sub(r"\g<1>W,W,W\g<2>", s)
     return _HEX_ADDR.sub("0xADDR", s)
 
 

@@ -13,8 +13,10 @@ the host code produced it in.
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import sys
+import weakref
 from typing import IO
 
 LEVELS = ("error", "critical", "warning", "message", "info", "debug")
@@ -40,7 +42,17 @@ class LogRecord:
 
 
 class ShadowLogger:
-    """Buffered, simtime-sorted log sink."""
+    """Buffered, simtime-sorted log sink.
+
+    Buffered records are flushed at interpreter exit (atexit, via a
+    weakref so the hook never pins the logger alive) and on context
+    exit — an uncaught exception between heartbeats must not eat the
+    log lines already produced. Usable as a context manager::
+
+        with ShadowLogger() as logger:
+            logger.log(...)
+        # flushed here, even on exception
+    """
 
     def __init__(self, default_level: str = "message",
                  stream: IO | None = None):
@@ -49,6 +61,21 @@ class ShadowLogger:
         self._buf: list[LogRecord] = []
         self._seq = 0
         self._stream = stream if stream is not None else sys.stdout
+        ref = weakref.ref(self)
+        self._atexit = lambda: (lambda lg: lg and lg.flush())(ref())
+        atexit.register(self._atexit)
+
+    def __enter__(self) -> "ShadowLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.flush()
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:
+            pass
 
     def set_default_level(self, level: str) -> None:
         self._default = _RANK[level]
@@ -70,10 +97,15 @@ class ShadowLogger:
         self._seq += 1
 
     def flush(self) -> int:
-        """Write buffered records in (simtime, arrival) order."""
+        """Write buffered records in (simtime, arrival) order. Safe to
+        call at interpreter exit: a closed/broken stream drops the
+        batch instead of raising into the atexit machinery."""
         self._buf.sort(key=lambda r: (r.sim_ns, r.seq))
         n = len(self._buf)
-        for r in self._buf:
-            print(r.format(), file=self._stream)
+        try:
+            for r in self._buf:
+                print(r.format(), file=self._stream)
+        except ValueError:  # stream already closed (interpreter teardown)
+            pass
         self._buf.clear()
         return n

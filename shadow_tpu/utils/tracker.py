@@ -53,6 +53,15 @@ SUPERVISOR_HEADER = (
     "windows,windows-per-sec,events-per-sec,"
     "stall-margin-seconds,checkpoints-written"
 )
+# exact per-host record counts from the device trace drain (only with
+# --trace): unlike the [node] section's interval-sampled counter deltas,
+# these come straight from the per-event trace records, so drop and
+# retransmit attribution is exact down to the event
+TRACE_HEADER = (
+    "[shadow-heartbeat] [trace-header] time-seconds,name,"
+    "exec-records,send-records,net-drop-records,fault-drop-records,"
+    "lost-records"
+)
 
 
 @dataclasses.dataclass
@@ -184,15 +193,20 @@ class Tracker:
                  log_info: tuple[str, ...] = ("node",),
                  info_of: dict[str, tuple[str, ...]] | None = None,
                  level_of: dict[str, str] | None = None,
-                 faults: Any = None):
+                 faults: Any = None, trace: Any = None):
         self.names = names
         self.logger = logger
         self.log_info = log_info
         self.info_of = info_of or {}
         self.level_of = level_of or {}
         self.faults = faults  # CompiledFaults -> emit the [fault] section
+        self.trace = trace  # obs.TraceDrain -> emit the [trace] section
         self.prev = Snapshot.zero(len(names))
-        self._prev_ns = 0
+        # None until the first heartbeat lands; afterwards the guard in
+        # heartbeat() drops zero-length (or backwards) intervals so a
+        # driver that fires two beats at the same sim time can't emit
+        # all-zero delta rows or divide the interval math by nothing
+        self._prev_ns: int | None = None
         self._emitted_headers = False
 
     def _info(self, name: str) -> tuple[str, ...]:
@@ -202,6 +216,8 @@ class Tracker:
         return self.level_of.get(name, "message")
 
     def heartbeat(self, st, sim_ns: int) -> None:
+        if self._prev_ns is not None and sim_ns <= self._prev_ns:
+            return  # zero-length interval: nothing can have accumulated
         cur = snapshot(st)
         any_socket = any("socket" in self._info(n) for n in self.names)
         if not self._emitted_headers:
@@ -212,6 +228,8 @@ class Tracker:
                 self.logger.log(sim_ns, "tracker", "message", RAM_HEADER)
             if self.faults is not None:
                 self.logger.log(sim_ns, "tracker", "message", FAULT_HEADER)
+            if self.trace is not None:
+                self.logger.log(sim_ns, "tracker", "message", TRACE_HEADER)
             self._emitted_headers = True
         t_s = sim_ns // 1_000_000_000
         p = self.prev
@@ -246,12 +264,39 @@ class Tracker:
             self._ram_lines(st, sim_ns, t_s)
         if self.faults is not None:
             self._fault_lines(cur, sim_ns, t_s)
+        if self.trace is not None:
+            self._trace_lines(sim_ns, t_s)
         self.prev = cur
         self._prev_ns = sim_ns
 
+    def _trace_lines(self, sim_ns: int, t_s: int) -> None:
+        """Exact per-host record counts from the device trace drain.
+        Skips all-zero rows like the [fault] section; the drain must be
+        harvested (TraceDrain.drain_state) before the heartbeat or the
+        interval is empty and nothing is emitted."""
+        iv = self.trace.take_interval()
+        if iv is None:
+            return
+        g = lambda a, i: int(a[i]) if i < len(a) else 0
+        for i, name in enumerate(self.names):
+            if "node" not in self._info(name):
+                continue
+            ex = g(iv["exec"], i)
+            snd = g(iv["send"], i)
+            drp = g(iv["drop"], i)
+            fdrp = g(iv["fault_drop"], i)
+            lost = g(iv["lost"], i)
+            if ex == 0 and snd == 0 and drp == 0 and fdrp == 0 and lost == 0:
+                continue
+            self.logger.log(
+                sim_ns, name, self._level(name),
+                "[shadow-heartbeat] [trace] "
+                f"{t_s},{name},{ex},{snd},{drp},{fdrp},{lost}",
+            )
+
     def _fault_lines(self, cur: Snapshot, sim_ns: int, t_s: int) -> None:
         p = self.prev
-        downtime = self.faults.downtime_in(self._prev_ns, sim_ns)
+        downtime = self.faults.downtime_in(self._prev_ns or 0, sim_ns)
         for i, name in enumerate(self.names):
             if "node" not in self._info(name):
                 continue
