@@ -98,7 +98,7 @@ def python_baseline_rate(
 
 
 def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
-             batched=True):
+             batched=True, overflow="drop"):
     _enable_compile_cache()
     import jax
     import jax.numpy as jnp
@@ -120,8 +120,34 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
             hot_hosts=hot_hosts,
             hot_weight=hot_weight,
             batched=batched,
+            spill=4 * capacity if overflow == "spill" else 0,
         )
-        run = jax.jit(eng.run)
+        if overflow == "spill":
+            # window-stepped with host boundary harvest/refill: the spill
+            # run pays host round trips per window, which is exactly the
+            # overhead the skew_spill_* numbers exist to measure
+            from shadow_tpu.runtime.pressure import PressureController
+
+            step = jax.jit(eng.step_window)
+
+            class _SpillRunner:
+                def __init__(self):
+                    self.ctrl = None
+
+                def __call__(self, st, stop):
+                    self.ctrl = PressureController(
+                        N_HOSTS, capacity, eng.cfg.lookahead,
+                        n_args=phold.N_PHOLD_ARGS,
+                    )
+                    h0 = jnp.asarray(0, jnp.int32)
+                    while int(jax.device_get(st.now)) < int(stop):
+                        st = step(st, stop, h0)
+                        st = self.ctrl.boundary(st)
+                    return st
+
+            run = _SpillRunner()
+        else:
+            run = jax.jit(eng.run)
 
         # compile + warm-up on a short horizon
         st = init()
@@ -145,7 +171,18 @@ def tpu_rate(stop_s: int, *, hot_hosts=0, hot_weight=0.0, capacity=CAPACITY,
             break
     sweeps = int(st.stats.n_sweeps)
     dev = jax.devices()[0]
+    pressure = {}
+    if overflow == "spill":
+        snap = run.ctrl.snapshot(st)
+        pressure = {
+            "spilled": snap["spilled"],
+            "refilled": snap["refilled"],
+            "spill_lost": snap["spill_lost"],
+            "overdue": snap["overdue"],
+        }
     return {
+        "overflow": overflow,
+        **pressure,
         "events": executed,
         # flagged when even the device_get-pinned timing is implausible
         # (> 100M events/s/chip): the number should not be trusted
@@ -486,9 +523,17 @@ def skew_worker():
     stop_s = min(int(os.environ.get("BENCH_STOP_S", STOP_SIM_SECONDS)), 10)
     # hot-spot variant: 1.5% of hosts receive 30% of traffic (the skewed
     # workload of reference test_phold.c:36-52 weighted targets); larger
-    # queues absorb the hot hosts' backlog
-    r = tpu_rate(stop_s, hot_hosts=64, hot_weight=0.3, capacity=256)
-    print(json.dumps({f"skew_{k}": v for k, v in r.items()}))
+    # queues absorb the hot hosts' backlog. Run BOTH overflow modes at
+    # the same capacity: skew_* is the historical lossy-drop number
+    # (skew_lossy flags any silent loss), skew_spill_* prices the
+    # lossless spill path on the identical workload
+    out = {}
+    for mode, pre in (("drop", "skew_"), ("spill", "skew_spill_")):
+        r = tpu_rate(stop_s, hot_hosts=64, hot_weight=0.3, capacity=256,
+                     overflow=mode)
+        out.update({f"{pre}{k}": v for k, v in r.items()})
+        out[f"{pre}lossy"] = r["drops"] > 0
+        print(json.dumps(out), flush=True)
 
 
 def main():
@@ -613,6 +658,15 @@ def main():
                 rs.get("skew_sim_s_per_wall_s", 0.0), 3
             ),
             "skew_drops": rs.get("skew_drops", -1),
+            "skew_lossy": rs.get("skew_lossy", True),
+            # lossless-mode pricing on the identical skew workload
+            "skew_spill_events_per_s": round(
+                rs.get("skew_spill_events_per_s", 0.0), 1
+            ),
+            "skew_spill_drops": rs.get("skew_spill_drops", -1),
+            "skew_spill_spilled": rs.get("skew_spill_spilled", 0),
+            "skew_spill_refilled": rs.get("skew_spill_refilled", 0),
+            "skew_spill_lossy": rs.get("skew_spill_lossy", True),
         })
         print(json.dumps(out), flush=True)
     if tor_ok:

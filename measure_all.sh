@@ -34,4 +34,21 @@ timeout 600 python -m shadow_tpu --test --stoptime 5 \
   && timeout 120 python -m shadow_tpu.tools.export_trace \
        measure_trace.npz -o measure_trace.json 2>> "$S"
 echo "=== trace_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
+# queue-pressure smoke: the skewed example workload through the CLI under
+# all four --overflow modes at a deliberately small capacity. drop stays
+# lossy (counted), spill/grow must end with queue_drops 0, strict must
+# exit 76. Only the summary JSON lines join $R.
+for mode in drop spill grow strict; do
+  echo "=== pressure_smoke_$mode start $(date +%H:%M:%S)" >> "$S"
+  echo "{\"stage\": \"pressure_smoke_$mode\"}" >> "$R"
+  timeout 600 python -m shadow_tpu --test --stoptime 5 \
+    --heartbeat-frequency 2 --capacity 8 --overflow "$mode" \
+    > measure_pressure.out 2>> "$S"
+  rc=$?
+  tail -n 1 measure_pressure.out >> "$R"
+  echo "=== pressure_smoke_$mode exit=$rc $(date +%H:%M:%S)" >> "$S"
+  if [ "$mode" = strict ] && [ "$rc" -ne 76 ] && [ "$rc" -ne 0 ]; then
+    echo "pressure_smoke_strict: unexpected exit $rc" >> "$S"
+  fi
+done
 echo ALL_DONE >> "$S"

@@ -53,9 +53,20 @@ def make_parser() -> argparse.ArgumentParser:
                    help="event-queue slots per host (default: sized to "
                         "hold a full TCP receive window in flight)")
     p.add_argument("--allow-queue-overflow", action="store_true",
-                   help="count+continue on event-queue overflow instead of "
-                        "failing (the reference's queues are unbounded; "
-                        "overflow here drops the farthest-future events)")
+                   help="legacy alias for --overflow drop with counted, "
+                        "non-fatal drops")
+    p.add_argument("--overflow", default=None,
+                   choices=["spill", "strict", "grow", "drop"],
+                   help="event-queue overflow handling "
+                        "(docs/9-Queue-Pressure.md): 'spill' (default) is "
+                        "lossless — evicted events land in a device ring "
+                        "and a host reservoir re-inserts them at window "
+                        "boundaries; 'strict' aborts with exit 76 and a "
+                        "diagnostic bundle at the first would-be drop; "
+                        "'grow' spills and doubles --capacity at the first "
+                        "sign of pressure; 'drop' keeps the historical "
+                        "lossy counted behavior (sharded meshes default "
+                        "to drop: spill is unsharded-only for now)")
     p.add_argument("--log-level", "-l", default="message",
                    choices=["error", "critical", "warning", "message",
                             "info", "debug"])
@@ -182,6 +193,7 @@ def _make_observability(cfg, sim, args, trace=None):
     tracker = Tracker(
         sim.names, logger, log_info=("node",), info_of=info_of,
         level_of=level_of, faults=sim.faults, trace=trace,
+        pressure=sim.pressure,
     )
     return logger, tracker
 
@@ -232,6 +244,21 @@ def main(argv=None) -> int:
                 parse_fault_dsl(s) for s in args.fault
             ),
         )
+
+    # overflow-mode resolution: lossless spill is the default, but the
+    # sharded engine doesn't speak the reservoir's boundary protocol yet,
+    # so meshes quietly keep the historical counted-drop behavior unless
+    # the user explicitly asks for a lossless mode (then we fail loudly
+    # in build_simulation rather than silently losing events)
+    overflow = args.overflow
+    if args.allow_queue_overflow:
+        if overflow not in (None, "drop"):
+            print("error: --allow-queue-overflow conflicts with "
+                  f"--overflow {overflow}", file=sys.stderr)
+            return 2
+        overflow = "drop"
+    if overflow is None:
+        overflow = "drop" if args.mesh else "spill"
 
     # configs whose plugins are real shared objects run on the process
     # tier: native green threads + window-batched syscall exchange (the
@@ -286,6 +313,7 @@ def main(argv=None) -> int:
                 rx_queue=args.router_queue, qdisc=args.interface_qdisc,
                 interface_buffer=args.interface_buffer, mesh=tier_mesh,
                 locality=args.locality, trace=args.trace, profiler=prof,
+                overflow=overflow,
             )
         sup = Supervisor(
             watchdog_timeout=args.watchdog, diag_dir=args.diag_dir,
@@ -296,10 +324,21 @@ def main(argv=None) -> int:
                 "exit_codes": {str(k): v for k, v in tier.exit_codes.items()},
             },
         )
+        from shadow_tpu.runtime import EXIT_PRESSURE
+        from shadow_tpu.runtime.pressure import (
+            QueuePressureError, pressure_bundle,
+        )
+
         try:
             with sup:
                 st = tier.run(supervisor=sup)
             wall = time.perf_counter() - t0
+        except QueuePressureError as e:
+            path = pressure_bundle(e, diag_dir=args.diag_dir,
+                                   label="shadow_tpu.proc")
+            print(f"shadow_tpu: QUEUE PRESSURE under --overflow strict: "
+                  f"{e}\ndiagnostic bundle -> {path}", file=sys.stderr)
+            return EXIT_PRESSURE
         finally:
             # abnormal exits (stall abort is os._exit and skips this, but
             # signals/exceptions land here) still surface the plugin log
@@ -357,10 +396,13 @@ def main(argv=None) -> int:
 
         mesh = make_mesh(args.mesh, dcn_slices=args.dcn_slices)
     prof, _phase = _make_profiler(args)
-    with _phase("build"):
-        sim = build_simulation(
+
+    def _build(capacity):
+        # one closure for the initial build AND the --overflow grow
+        # re-template (doubled capacity, everything else identical)
+        return build_simulation(
             cfg, seed=args.seed, n_sockets=args.sockets,
-            capacity=args.capacity,
+            capacity=capacity,
             mesh=mesh, tcp_cc=args.tcp_congestion_control,
             rx_queue=args.router_queue, qdisc=args.interface_qdisc,
             interface_buffer=args.interface_buffer, locality=args.locality,
@@ -369,7 +411,11 @@ def main(argv=None) -> int:
                 if args.runahead is not None else None
             ),
             trace=args.trace, profiler=prof,
+            overflow=overflow,
         )
+
+    with _phase("build"):
+        sim = _build(args.capacity)
     if args.allow_queue_overflow:
         sim.strict_overflow = False
     tdrain = None
@@ -379,6 +425,12 @@ def main(argv=None) -> int:
         tdrain = TraceDrain(
             args.trace, names=sim.names, kind_names=list(sim.kind_names)
         )
+        if sim.pressure is not None:
+            # spill/refill are host-side moments: the controller injects
+            # synthetic OP_SPILL/OP_REFILL rows into the same drain
+            sim.pressure.attach_trace(
+                tdrain, len_arg=sim.engine.cfg.trace_len_arg
+            )
         print(f"event trace: {args.trace} records/host/interval -> "
               f"{args.trace_out}", file=sys.stderr)
     n_hosts = len(sim.names)
@@ -435,6 +487,15 @@ def main(argv=None) -> int:
                 print(f"warning: --resume auto: skipping {p}: {reason}",
                       file=sys.stderr)
         st, meta = load_checkpoint(resume_path, sim.state0)
+        if sim.pressure is not None:
+            # mid-pressure resume: the reservoir rides the checkpoint's
+            # extra section; restoring it keeps --resume bit-exact even
+            # with events parked off-device at the write
+            from shadow_tpu.utils.checkpoint import read_extra
+
+            extras = read_extra(resume_path)
+            if extras:
+                sim.pressure.restore(extras)
         if meta.get("seed") is not None and meta["seed"] != args.seed:
             print(f"error: checkpoint was written with --seed {meta['seed']}"
                   f" but this run uses --seed {args.seed}; resume would not "
@@ -470,8 +531,11 @@ def main(argv=None) -> int:
         )
         print(f"pcap capture: {len(sim.pcap_gids)} hosts -> {sim.pcap_dir}/",
               file=sys.stderr)
-    from shadow_tpu.runtime import EXIT_INVARIANT, Supervisor
+    from shadow_tpu.runtime import EXIT_INVARIANT, EXIT_PRESSURE, Supervisor
     from shadow_tpu.runtime.invariants import InvariantViolation, validate
+    from shadow_tpu.runtime.pressure import (
+        QueuePressureError, pressure_bundle,
+    )
     from shadow_tpu.utils import save_checkpoint
     from shadow_tpu.utils.tracker import SupervisorHeartbeat
 
@@ -493,11 +557,14 @@ def main(argv=None) -> int:
                 meta={"sim_seconds": sim_s, "seed": args.seed,
                       "config_digest": cfg_digest, **extra_meta},
                 keep=1 if path else args.checkpoint_keep,
+                extra=(sim.pressure.serialize()
+                       if sim.pressure is not None else None),
             )
         sup_hb.checkpoint_written()
 
     last_validated_windows = 0
     prev_validated_now = None
+    prev_validated_drops = None
     t1 = time.perf_counter()
     try:
         with sup:
@@ -506,6 +573,26 @@ def main(argv=None) -> int:
                 st = sim.run(int(nxt * SECOND), state=st)
                 st.now.block_until_ready()
                 sim_s = nxt
+                if sim.pressure is not None and sim.pressure.grow_wanted:
+                    # --overflow grow: rebuild the engine at doubled
+                    # capacity, carry the live state across through the
+                    # checkpoint transfer path, keep the SAME controller
+                    # (reservoir + counters survive; the tracker holds a
+                    # reference to it), then refill into the new room
+                    from shadow_tpu.utils.checkpoint import transfer_state
+
+                    ctrl = sim.pressure
+                    new_cap = sim.engine.cfg.capacity * 2
+                    print(f"shadow_tpu: queue pressure under --overflow "
+                          f"grow: re-templating at --capacity {new_cap} "
+                          f"(sim {sim_s:.3f}s)", file=sys.stderr)
+                    with _phase("build"):
+                        sim = _build(new_cap)
+                    st = transfer_state(st, sim.state0)
+                    ctrl.capacity = new_cap
+                    ctrl.grow_wanted = False
+                    sim.pressure = ctrl
+                    st = ctrl.boundary(st)
                 summary_now = sim.summary(st)
                 sup.pet(sim_seconds=sim_s, **summary_now)
                 sup_hb.observe_margin()
@@ -514,8 +601,11 @@ def main(argv=None) -> int:
                     >= args.validate
                 ):
                     prev_validated_now = validate(
-                        st, prev_now=prev_validated_now
+                        st, prev_now=prev_validated_now,
+                        prev_drops=prev_validated_drops,
+                        pressure=sim.pressure,
                     )
+                    prev_validated_drops = jax.device_get(st.queues.drops)
                     last_validated_windows = summary_now["windows"]
                 if prof is not None:
                     from shadow_tpu.obs import queue_fill
@@ -559,6 +649,17 @@ def main(argv=None) -> int:
         print(f"shadow_tpu: INVARIANT VIOLATION at sim {sim_s:.3f}s\n{e}",
               file=sys.stderr)
         return EXIT_INVARIANT
+    except QueuePressureError as e:
+        # --overflow strict: the state is healthy (nothing was actually
+        # lost — the run stopped at the first would-be drop), but the
+        # campaign's no-loss contract is broken; leave a machine-readable
+        # bundle and the distinct exit code instead of a stack trace
+        path = pressure_bundle(e, diag_dir=args.diag_dir,
+                               label="shadow_tpu")
+        print(f"shadow_tpu: QUEUE PRESSURE at sim {sim_s:.3f}s under "
+              f"--overflow strict: {e}\ndiagnostic bundle -> {path}",
+              file=sys.stderr)
+        return EXIT_PRESSURE
     except BaseException as e:
         # unhandled driver failure: best-effort emergency checkpoint of
         # the last completed window batch, then re-raise — diagnosis
@@ -645,6 +746,9 @@ def main(argv=None) -> int:
             )
         },
     }
+    if sim.pressure is not None:
+        summary["pressure"] = sim.pressure.snapshot(st)
+        summary["capacity"] = int(sim.engine.cfg.capacity)
     if drain is not None:
         # packet-lifecycle class counts from the capture rings (the
         # PDS_* stage tallies of packet.h:20-40)

@@ -209,13 +209,22 @@ def state_summary(state: EngineState) -> dict:
         state.now, state.stats.n_windows, state.stats.n_executed.sum(),
         state.stats.n_sweeps, state.queues.drops.sum(),
     ))
-    return {
+    out = {
         "now_ns": int(now),
         "windows": int(windows),
         "executed": int(executed),
         "sweeps": int(sweeps),
         "queue_drops": int(drops),
     }
+    ring = state.queues.spill
+    if ring is not None:
+        spilled, lost, hwm = jax.device_get((
+            ring.n_spilled.sum(), ring.n_lost.sum(), ring.fill_hwm.max(),
+        ))
+        out["spilled"] = int(spilled)
+        out["spill_lost"] = int(lost)
+        out["fill_hwm"] = int(hwm)
+    return out
 
 
 # Handler signature: (host_state_slice, ev: Events scalar, key) ->
@@ -245,6 +254,14 @@ class EngineConfig:
     # args column holding the payload-length word for trace records
     # (A_LEN for the packet stack; harmless 0 for bare-engine models)
     trace_len_arg: int = 0
+    # Overflow-spill ring slots per host (shadow_tpu.runtime.pressure):
+    # queue evictions land in a per-host device ring that a host-side
+    # reservoir harvests at window boundaries instead of being dropped.
+    # 0 (the default) compiles the spill path away entirely —
+    # EventQueue.spill is None (a leaf-free pytree subtree), so the
+    # jitted program and the checkpoint leaf list are identical to a
+    # spill-free build, the same zero-cost discipline as `trace`.
+    spill: int = 0
     # Burst delivery: fold contiguous same-flow packet arrivals staged in
     # one sweep into a single multi-segment event — the chained drain's
     # sequential depth is the busiest host's event count, and TCP data
@@ -285,6 +302,8 @@ class EngineConfig:
             )
         if self.trace < 0:
             raise ValueError(f"trace must be >= 0, got {self.trace}")
+        if self.spill < 0:
+            raise ValueError(f"spill must be >= 0, got {self.spill}")
         if not 0 <= self.trace_len_arg < self.n_args:
             raise ValueError(
                 f"trace_len_arg {self.trace_len_arg} outside "
@@ -544,7 +563,9 @@ class Engine:
 
     def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
         cfg = self.cfg
-        q = EventQueue.create(cfg.n_hosts, cfg.capacity, cfg.n_args)
+        q = EventQueue.create(
+            cfg.n_hosts, cfg.capacity, cfg.n_args, spill=cfg.spill
+        )
         flat = initial.flatten()
         valid = flat.time != TIME_INVALID
         q = queue_push(q, flat, valid, host0)

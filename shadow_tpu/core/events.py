@@ -16,12 +16,17 @@ push is implemented as "group incoming events by destination via one flat
 sort, slice each host's contiguous run, concatenate to the row, re-sort
 the row" with no scatter anywhere, and pop-min / frontier extraction are
 free prefix reads of the sorted rows. Bounded capacity drops the
-*largest*-key events on overflow and accounts them in `drops`.
+*largest*-key events on overflow and accounts them in `drops` — or, when
+the queue carries a `SpillRing` (shadow_tpu.runtime.pressure), lands them
+in the per-host overflow ring instead so a host-side reservoir can
+harvest and re-insert them at window boundaries (lossless pressure
+handling; see docs/9-Queue-Pressure.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -107,13 +112,61 @@ class Events:
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
+class SpillRing:
+    """Per-host overflow ring: events evicted by `queue_push` land here
+    instead of vanishing, in eviction order, for a host-side reservoir
+    (shadow_tpu.runtime.pressure) to harvest at window boundaries.
+
+    Same stop-at-full SoA discipline as obs.trace.TraceRing: `wr` counts
+    events *offered* since the last reset; records land at min(wr, cap)
+    so a full ring's writes fall into `slack` scratch columns (sized to
+    the widest single eviction, the queue capacity) that the harvester
+    never reads. Ring-overflow events are the only ones truly lost under
+    spill, accounted in both `n_lost` and the queue's `drops`.
+
+    Payload rides bit-packed exactly as inside `queue_push` (kind + args
+    as i64 word pairs), so spilling adds no pack/unpack work to the merge.
+    """
+
+    time: jax.Array  # i64[H, cap + slack]
+    srcseq: jax.Array  # i64[H, cap + slack] pack_srcseq(src, seq)
+    pay: jax.Array  # i64[H, cap + slack, NW] packed kind+args words
+    wr: jax.Array  # i32[H] events offered since last reset
+    n_spilled: jax.Array  # i64[H] cumulative events evicted into the ring
+    n_lost: jax.Array  # i64[H] cumulative events lost to ring overflow
+    fill_hwm: jax.Array  # i32[H] high-water mark of queue fill
+
+    @staticmethod
+    def create(n_hosts: int, cap: int, slack: int, n_args: int = N_ARGS
+               ) -> "SpillRing":
+        nw = (1 + n_args + 1) // 2  # payload words, packed two per i64
+        width = cap + slack
+        return SpillRing(
+            time=jnp.full((n_hosts, width), TIME_INVALID, jnp.int64),
+            srcseq=jnp.zeros((n_hosts, width), jnp.int64),
+            pay=jnp.zeros((n_hosts, width, nw), jnp.int64),
+            wr=jnp.zeros((n_hosts,), jnp.int32),
+            n_spilled=jnp.zeros((n_hosts,), jnp.int64),
+            n_lost=jnp.zeros((n_hosts,), jnp.int64),
+            fill_hwm=jnp.zeros((n_hosts,), jnp.int32),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
 class EventQueue:
     """All hosts' bounded event queues on one shard: [H, C] slot arrays.
 
     A slot is empty iff time == TIME_INVALID. `drops` counts events lost to
     queue overflow per host (the reference's queues are unbounded; we bound
     and account, in the spirit of its ObjectCounter leak accounting —
-    reference: src/main/core/support/object_counter.c).
+    reference: src/main/core/support/object_counter.c). i64: multi-hour
+    campaigns overflow an i32 long before they finish.
+
+    `spill` is None (zero pytree leaves — compiled program and checkpoint
+    leaf layout identical to a spill-free build) unless the engine was
+    configured with an overflow ring, in which case evictions land there
+    instead of being counted as drops.
     """
 
     time: jax.Array  # i64[H, C]
@@ -121,10 +174,12 @@ class EventQueue:
     seq: jax.Array  # i32[H, C]
     kind: jax.Array  # i32[H, C]
     args: jax.Array  # i32[H, C, N_ARGS]
-    drops: jax.Array  # i32[H]
+    drops: jax.Array  # i64[H]
+    spill: Any = None  # SpillRing, or None when spill is off
 
     @staticmethod
-    def create(n_hosts: int, capacity: int, n_args: int = N_ARGS) -> "EventQueue":
+    def create(n_hosts: int, capacity: int, n_args: int = N_ARGS,
+               spill: int = 0) -> "EventQueue":
         i32 = jnp.int32
         return EventQueue(
             time=jnp.full((n_hosts, capacity), TIME_INVALID, jnp.int64),
@@ -132,7 +187,13 @@ class EventQueue:
             seq=jnp.zeros((n_hosts, capacity), i32),
             kind=jnp.zeros((n_hosts, capacity), i32),
             args=jnp.zeros((n_hosts, capacity, n_args), i32),
-            drops=jnp.zeros((n_hosts,), i32),
+            drops=jnp.zeros((n_hosts,), jnp.int64),
+            # slack = capacity: every merge round evicts at most
+            # w <= min(C, M) <= C events per host in one append
+            spill=(
+                SpillRing.create(n_hosts, spill, capacity, n_args)
+                if spill > 0 else None
+            ),
         )
 
     @property
@@ -242,7 +303,14 @@ def queue_push(
     cross-shard events via collectives before pushing). When a destination
     queue overflows its capacity, the *largest*-key events are dropped and
     counted in `drops` (the reference's heaps are unbounded; we bound and
-    account — src/main/core/support/object_counter.c spirit).
+    account — src/main/core/support/object_counter.c spirit) — unless the
+    queue carries a SpillRing, in which case every evicted event lands in
+    the ring (the sorted merge leaves the evicted tail contiguous, so the
+    capture is one vmapped dynamic_update_slice per field) and only
+    ring-overflow events count as drops. With a ring attached the final
+    round's admission width is not capped either: extra full-width rounds
+    run under a while_loop until every rank is admitted, so no event can
+    bypass the ring as an unmaterialized rank-overflow.
 
     Scatter-AND-gather-free algorithm (TPU: computed-index scatters —
     and computed-index gathers at this scale: a [H, W]-lane row gather
@@ -419,20 +487,70 @@ def queue_push(
             over = jnp.sum(
                 mt[:, hc:] != TIME_INVALID, axis=1, dtype=jnp.int32
             )
-            if count_tail:
-                over = over + jnp.maximum(count - lo - w, 0)
+            spill = q.spill
+            if spill is None:
+                if count_tail:
+                    over = over + jnp.maximum(count - lo - w, 0)
+                drops_add = over.astype(jnp.int64)
+            else:
+                # the merged row is sorted with empties last, so the
+                # evicted events sit contiguously at the FRONT of the
+                # [H, w] tail: append the whole tail at min(wr, cap) and
+                # advance the cursor by the valid count only — garbage
+                # beyond it is overwritten by the next append or never
+                # read (slack columns absorb full-ring writes)
+                scap = spill.time.shape[1] - c  # slack == queue capacity
+                starts = jnp.minimum(spill.wr, scap)
+                put = jax.vmap(
+                    lambda row, rec, s: jax.lax.dynamic_update_slice(
+                        row, rec, (s,)
+                    )
+                )
+                put2 = jax.vmap(
+                    lambda row, rec, s: jax.lax.dynamic_update_slice(
+                        row, rec, (s, jnp.int32(0))
+                    )
+                )
+                wr2 = spill.wr + over
+                lost = (
+                    jnp.maximum(wr2 - scap, 0)
+                    - jnp.maximum(spill.wr - scap, 0)
+                ).astype(jnp.int64)
+                spill = SpillRing(
+                    time=put(spill.time, mt[:, hc:], starts),
+                    srcseq=put(spill.srcseq, mss[:, hc:], starts),
+                    pay=put2(
+                        spill.pay,
+                        jnp.stack([p[:, hc:] for p in mpay], axis=-1),
+                        starts,
+                    ),
+                    wr=wr2,
+                    n_spilled=spill.n_spilled + over.astype(jnp.int64),
+                    n_lost=spill.n_lost + lost,
+                    fill_hwm=spill.fill_hwm,
+                )
+                drops_add = lost
             new_src, new_seq = unpk(mss[:, :hc])
             words = unpack_words([p[:, :hc] for p in mpay], nw)
             glue = lambda head, tail: jnp.concatenate([head, tail], axis=1)
+            new_time = glue(mt[:, :hc], q.time[:, hc:])
+            if spill is not None:
+                fill = jnp.sum(
+                    new_time != TIME_INVALID, axis=1, dtype=jnp.int32
+                )
+                spill = dataclasses.replace(
+                    spill, fill_hwm=jnp.maximum(spill.fill_hwm, fill)
+                )
             return EventQueue(
-                time=glue(mt[:, :hc], q.time[:, hc:]),
+                time=new_time,
                 src=glue(new_src, q.src[:, hc:]),
                 seq=glue(new_seq, q.seq[:, hc:]),
                 kind=glue(words[0], q.kind[:, hc:]),
                 args=jnp.concatenate(
                     [jnp.stack(words[1:], axis=-1), q.args[:, hc:]], axis=1
                 ),
-                drops=q.drops + over,
+                drops=q.drops + drops_add,
+                spill=spill,
             )
 
         if c < 2 * HOT_C:
@@ -460,12 +578,28 @@ def queue_push(
 
     w_full = min(c, m)
     w1 = min(w_full, MERGE_W)
-    if w1 == w_full:
-        return merge_round(q, 0, w_full, True)
+    if q.spill is None:
+        if w1 == w_full:
+            return merge_round(q, 0, w_full, True)
+        q = merge_round(q, 0, w1, False)
+        return jax.lax.cond(
+            jnp.any(count > w1),
+            lambda q: merge_round(q, w1, w_full, True),
+            lambda q: q,
+            q,
+        )
+    # spill: a rank past lo + w in the last round would be dropped
+    # without ever materializing in the ring, so instead of capping,
+    # keep admitting at full width until every rank is covered (the
+    # ring slack equals the queue capacity >= w_full, so each round's
+    # eviction tail always fits one append)
     q = merge_round(q, 0, w1, False)
-    return jax.lax.cond(
-        jnp.any(count > w1),
-        lambda q: merge_round(q, w1, w_full, True),
-        lambda q: q,
-        q,
+    q, _ = jax.lax.while_loop(
+        lambda carry: jnp.any(count > carry[1]),
+        lambda carry: (
+            merge_round(carry[0], carry[1], w_full, False),
+            carry[1] + w_full,
+        ),
+        (q, jnp.asarray(w1, jnp.int32)),
     )
+    return q

@@ -139,6 +139,7 @@ def build(
     drain_batch: int = 24,
     batched: bool = False,
     trace: int = 0,
+    spill: int = 0,
 ):
     """Build (engine, initial_state) for an n_hosts PHOLD network.
 
@@ -158,6 +159,7 @@ def build(
         n_shards=n_shards,
         drain_batch=drain_batch,
         trace=trace,
+        spill=spill,
     )
     net = ConstantNetwork(latency_ns)
     eng = Engine(

@@ -21,7 +21,9 @@ from shadow_tpu.obs.trace import (  # noqa: F401
     OP_EXEC,
     OP_FDROP,
     OP_NAMES,
+    OP_REFILL,
     OP_SEND,
+    OP_SPILL,
     TraceDrain,
     TraceRing,
     trace_append,

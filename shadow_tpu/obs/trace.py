@@ -37,7 +37,8 @@ Record schema (all [H, cap+slack], int32 unless noted):
   kind       handler/event kind index
   plen       payload-length arg (raw word; burst folds pack count<<24)
   seq        per-source sequence number
-  op         record class: OP_EXEC / OP_SEND / OP_DROP / OP_FDROP
+  op         record class: OP_EXEC / OP_SEND / OP_DROP / OP_FDROP, or
+             the host-injected OP_SPILL / OP_REFILL pressure pair
 
 Flow reconstruction: an OP_SEND row on the source host and the OP_EXEC
 row of the same (src, seq) on the destination host are the two ends of
@@ -60,9 +61,16 @@ OP_EXEC = 0   # event executed (row = executing host)
 OP_SEND = 1   # non-local emit routed onto the wire (row = source host)
 OP_DROP = 2   # non-local emit lost to a reliability roll
 OP_FDROP = 3  # non-local emit lost to the fault overlay
+# pressure path (host-side synthetic records, TraceDrain.inject): an
+# event evicted from the bounded device queue into the spill ring, and
+# its later re-insertion from the host reservoir — together they bound
+# the event's off-device residency in the exported timeline
+OP_SPILL = 4   # evicted to the spill ring (row = owning host)
+OP_REFILL = 5  # re-seated from the reservoir (row = owning host)
 
 OP_NAMES = {OP_EXEC: "exec", OP_SEND: "send", OP_DROP: "drop",
-            OP_FDROP: "fault_drop"}
+            OP_FDROP: "fault_drop", OP_SPILL: "spill",
+            OP_REFILL: "refill"}
 
 _FIELDS = ("time", "src", "dst", "kind", "plen", "seq", "op")
 
@@ -195,6 +203,31 @@ class TraceDrain:
             self.n_records += drained
         self._acc_interval(seg, lost, h)
         return drained
+
+    def inject(self, *, time, src, dst, kind, plen, seq, op, owner,
+               n_hosts: int) -> int:
+        """Append host-side synthetic records (the pressure layer's
+        OP_SPILL / OP_REFILL rows — those moments happen on the host, so
+        the device ring never sees them). Records enter the same segment
+        list and interval accounting as drained device records, and the
+        deterministic sort in `records()` interleaves them byte-stably.
+        `op` may be a scalar; array fields must share one length."""
+        time = np.asarray(time, np.int64).reshape(-1)
+        n = int(time.shape[0])
+        if n == 0:
+            return 0
+        as32 = lambda a: np.broadcast_to(
+            np.asarray(a, np.int32).reshape(-1), (n,)
+        ).copy()
+        seg = {
+            "time": time, "src": as32(src), "dst": as32(dst),
+            "kind": as32(kind), "plen": as32(plen), "seq": as32(seq),
+            "op": as32(op), "owner": as32(owner),
+        }
+        self._segs.append(seg)
+        self.n_records += n
+        self._acc_interval(seg, np.zeros((n_hosts,), np.int64), n_hosts)
+        return n
 
     def drain_state(self, state: Any) -> Any:
         """Drain `state.trace` and return the state with the ring reset

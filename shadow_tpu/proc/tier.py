@@ -96,8 +96,9 @@ class ProcessTier:
                  rx_queue: str = "codel", qdisc: str = "fifo",
                  interface_buffer: int = 1_024_000, mesh=None,
                  driver_slots: int | None = None, locality: bool = False,
-                 trace: int = 0, profiler=None):
+                 trace: int = 0, profiler=None, overflow: str = "drop"):
         self.strict_overflow = strict_overflow
+        self.overflow = overflow
         self.model = ProcTierModel()
         # hard slot-space split: device-created children live in
         # [0, child_limit), driver-owned sockets in [child_limit, S).
@@ -115,7 +116,7 @@ class ProcessTier:
             app_model=self.model, tcp_cc=tcp_cc, rx_queue=rx_queue,
             qdisc=qdisc, interface_buffer=interface_buffer, mesh=mesh,
             tcp_child_slot_limit=self._child_limit, locality=locality,
-            trace=trace, profiler=profiler,
+            trace=trace, profiler=profiler, overflow=overflow,
         )
         self.rt = ShimRuntime()
         self.rt.set_seed(seed)  # roots plugin rand()/urandom determinism
@@ -851,6 +852,11 @@ class ProcessTier:
             if fcur < len(flips):
                 bound = min(bound, max(flips[fcur][0], now + 1))
             st = sim.step_window(st, bound)
+            if sim.pressure is not None:
+                # the tier already steps window-by-window (bounded by
+                # host-side interest points), so the spill reservoir's
+                # harvest/refill hook slots in at every boundary for free
+                st = sim.pressure.boundary(st)
             now = int(jax.device_get(st.now))
             self._observe(st)
             if self._udp_zombie_deadline:
@@ -860,7 +866,13 @@ class ProcessTier:
                     self._udp_src_zombies.pop(zk, None)
                     self._udp_outstanding.pop(zk, None)
         drops = int(jax.device_get(st.queues.drops.sum()))
-        if drops and self.strict_overflow:
+        if drops and self.overflow == "strict":
+            from shadow_tpu.runtime.pressure import QueuePressureError
+
+            raise QueuePressureError(
+                drops, self.sim.engine.cfg.capacity, self.sim.summary(st)
+            )
+        if drops and self.strict_overflow and self.overflow == "drop":
             raise RuntimeError(
                 f"event queue overflow: {drops} events dropped (capacity "
                 f"{self.sim.engine.cfg.capacity}); native processes may "

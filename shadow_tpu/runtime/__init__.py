@@ -15,17 +15,25 @@ makes the *driver process* survive it too:
   SIGUSR1 an on-demand checkpoint.
 - `invariants` — off-the-hot-path EngineState validator (monotonic
   clock, sorted queue rows with empties last, non-negative counters,
-  NaN scan) that fails loudly with the offending leaf path.
+  NaN scan, queue-pressure accounting) that fails loudly with the
+  offending leaf path.
+- `pressure` — lossless queue-overflow handling: the host-side
+  reservoir over the device spill ring (core.events.SpillRing), the
+  strict/grow/spill/drop degradation modes, and the window-boundary
+  harvest/refill loop (docs/9-Queue-Pressure.md).
 
-Nothing in this package imports jax at module load: the watchdog and
-signal plumbing are usable (and unit-testable) without touching a
-device backend.
+Nothing imported by this package's __init__ imports jax at module
+load: the watchdog and signal plumbing are usable (and unit-testable)
+without touching a device backend. `pressure` does import jax and is
+imported explicitly by the layers that need it.
 """
 
 from shadow_tpu.runtime.supervisor import (  # noqa: F401
     EXIT_INVARIANT,
+    EXIT_PRESSURE,
     EXIT_STALL,
     Supervisor,
     Watchdog,
     signal_exit_code,
+    write_diagnostic_bundle,
 )

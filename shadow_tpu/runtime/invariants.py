@@ -13,7 +13,13 @@ would cost every window what they cost once per validation interval):
   (time == TIME_INVALID) packed last;
 - counters that only ever increment are non-negative (stats, queue
   drops, per-source sequence numbers, executed-event counts);
-- no float leaf anywhere in the state holds NaN/Inf.
+- no float leaf anywhere in the state holds NaN/Inf;
+- under queue pressure (--overflow spill/grow): drops are monotonic
+  non-decreasing across validations, every reservoir key is >= the
+  device queue's max key per host (the total-order guarantee the spill
+  path's losslessness rests on), and the spill ring's cumulative
+  accounting reconciles — every event ever evicted is exactly one of
+  harvested, lost to ring overflow, or still pending in the ring.
 
 Failures raise `InvariantViolation` naming the offending leaf path and
 host row, so a corrupted run dies loudly at the next validation boundary
@@ -39,11 +45,16 @@ def _leaf_items(tree: Any):
 
 
 def check_state(state: Any, *, prev_now: int | None = None,
+                prev_drops: Any | None = None,
+                pressure: Any | None = None,
                 max_violations: int = 10) -> list[str]:
     """Return a list of violation strings (empty = state is sound).
 
     `prev_now` is the clock observed at the previous validation; pass it
-    to catch time running backwards between checks. One batched
+    to catch time running backwards between checks. `prev_drops` is the
+    per-host drop counter from the previous validation (same purpose).
+    `pressure` is the run's PressureController, if any — enables the
+    reservoir-ordering and ring-accounting checks. One batched
     device_get; everything after is numpy.
     """
     import jax
@@ -120,6 +131,47 @@ def check_state(state: Any, *, prev_now: int | None = None,
                        f"{int(arr[idx])}"):
                     return viols
 
+    # 3b. drops only ever increase (a decrease means the counter was
+    # clobbered — e.g. a bad grow transfer or checkpoint mix-up)
+    if prev_drops is not None:
+        drops = np.asarray(jax.device_get(state.queues.drops))
+        prev = np.asarray(prev_drops)
+        for h in np.nonzero(drops < prev)[0][:3]:
+            if add(f".queues.drops[host {int(h)}]: ran backwards "
+                   f"{int(prev[h])} -> {int(drops[h])}"):
+                return viols
+
+    # 5. pressure: reservoir/ring contracts (spill and grow modes)
+    ring = getattr(state.queues, "spill", None)
+    if pressure is not None and ring is not None:
+        # 5a. total order: every reservoir key >= the device max key per
+        # host — refill pushes reservoir minima through queue_push, so a
+        # smaller reservoir key would mean a future event was admitted
+        # out of order (losslessness is gone)
+        res_min = np.asarray(pressure.reservoir_min_keys())
+        neg = np.iinfo(np.int64).min
+        dev_max = np.max(np.where(valid, q_time, neg), axis=1)
+        bad = (res_min < dev_max) & valid.any(axis=1)
+        for h in np.nonzero(bad)[0][:3]:
+            if add(f"pressure[host {int(h)}]: reservoir min key "
+                   f"{int(res_min[h])} < device queue max "
+                   f"{int(dev_max[h])} (total order broken)"):
+                return viols
+        # 5b. accounting: spilled == harvested + lost + pending-in-ring
+        n_spilled, n_lost, wr = (
+            np.asarray(x) for x in jax.device_get(
+                (ring.n_spilled, ring.n_lost, ring.wr))
+        )
+        scap = ring.time.shape[1] - q_time.shape[1]
+        pending = np.minimum(wr, scap).astype(np.int64)
+        expect = np.asarray(pressure.n_harvested) + n_lost + pending
+        for h in np.nonzero(n_spilled != expect)[0][:3]:
+            if add(f"pressure[host {int(h)}]: ring accounting broken: "
+                   f"spilled {int(n_spilled[h])} != harvested "
+                   f"{int(pressure.n_harvested[h])} + lost "
+                   f"{int(n_lost[h])} + pending {int(pending[h])}"):
+                return viols
+
     # 4. NaN/Inf scan over every float leaf of the whole state
     for path, leaf in _leaf_items(state):
         arr = np.asarray(jax.device_get(leaf))
@@ -134,12 +186,15 @@ def check_state(state: Any, *, prev_now: int | None = None,
     return viols
 
 
-def validate(state: Any, *, prev_now: int | None = None) -> int:
+def validate(state: Any, *, prev_now: int | None = None,
+             prev_drops: Any | None = None,
+             pressure: Any | None = None) -> int:
     """Raise InvariantViolation listing every violation found; return
     the state's clock (feed it back as the next call's prev_now)."""
     import jax
 
-    viols = check_state(state, prev_now=prev_now)
+    viols = check_state(state, prev_now=prev_now, prev_drops=prev_drops,
+                        pressure=pressure)
     if viols:
         raise InvariantViolation(
             "EngineState invariant violation"

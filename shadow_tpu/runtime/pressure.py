@@ -1,0 +1,511 @@
+"""Lossless queue-pressure handling: host reservoir over the device
+spill ring, plus the strict/grow degradation modes.
+
+The engine's per-host queues are bounded (the reference's heaps are
+unbounded — src/main/utility/priority_queue.c); before this layer,
+overflow silently dropped the *largest*-key events, so results under
+hot-spot load were quietly wrong. Four `--overflow` modes now bound the
+damage:
+
+  spill   (default) evictions land in a per-host device ring
+          (core.events.SpillRing, written inside the jitted window loop
+          with the same SoA/dynamic_update_slice discipline as
+          obs.trace.TraceRing); at every window boundary the host-side
+          `PressureController` harvests the ring into per-host numpy
+          min-heaps (the reservoir) and re-inserts events so the device
+          queue always holds the globally smallest keys. Lossless while
+          a host's per-window demand fits its queue; `n_overdue` counts
+          the (pathological) remainder.
+  strict  no ring; the first would-be drop aborts the run with exit 76
+          (EXIT_PRESSURE) and a diagnostic bundle via the supervisor
+          layer — for campaigns where silent loss must be impossible.
+  grow    spill, plus: the first sign of pressure asks the driver to
+          re-template the engine at doubled capacity, carrying state
+          through the checkpoint transfer path (utils.checkpoint
+          .transfer_state); the reservoir then refills into the larger
+          queue, so nothing is lost across the switch.
+  drop    the historical behavior: count overflow in `queues.drops`,
+          keep going (speed studies).
+
+Why window boundaries are safe harvest points: the conservative engine
+only pushes events at or past the current window's end during a drain
+(cross-host sends are clamped to the barrier), so an evicted largest-key
+event always carries a key >= window end — it cannot be needed before
+the boundary at which it is re-inserted. Refill restores the invariant
+"device queue holds the per-host smallest keys; every reservoir key is
+>= every device key" by pushing reservoir minima through the ordinary
+`queue_push` merge (which evicts any displaced larger keys back into the
+ring for immediate re-harvest), so (time, src, seq) determinism is
+preserved bit-for-bit — a capacity-C run with spill finishes in the same
+state as a capacity-2C run without it (pinned by tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import sys
+import time as _time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core.events import (
+    Events,
+    SpillRing,
+    pack_srcseq,
+    queue_push,
+)
+from shadow_tpu.core.timebase import TIME_INVALID
+from shadow_tpu.obs.trace import OP_REFILL, OP_SPILL
+from shadow_tpu.runtime.supervisor import (
+    EXIT_PRESSURE,
+    write_diagnostic_bundle,
+)
+
+OVERFLOW_MODES = ("spill", "strict", "grow", "drop")
+
+# refill iterations per boundary before declaring the host pathologically
+# oversubscribed (each iteration either raises the device fill or lowers
+# the device max key, so real workloads converge in one or two)
+_MAX_REFILL_ROUNDS = 8
+
+
+class QueuePressureError(RuntimeError):
+    """Raised under `--overflow strict` at the first would-be drop.
+
+    Carries the accounting the diagnostic bundle needs, so the driver
+    can abort with EXIT_PRESSURE and a machine-readable artifact rather
+    than a stack trace.
+    """
+
+    def __init__(self, drops: int, capacity: int, summary: dict):
+        self.drops = int(drops)
+        self.capacity = int(capacity)
+        self.summary = dict(summary)
+        super().__init__(
+            f"queue pressure: {drops} events would overflow the per-host "
+            f"event queues (capacity {capacity}); rerun with a larger "
+            "--capacity, or a lossless mode (--overflow spill/grow)"
+        )
+
+
+def pressure_bundle(exc: QueuePressureError, *, diag_dir: str,
+                    label: str) -> str:
+    """Write the strict-mode diagnostic bundle (exit code 76)."""
+    return write_diagnostic_bundle(
+        diag_dir, label, "pressure",
+        {
+            "reason": "queue pressure under --overflow strict",
+            "would_drop": exc.drops,
+            "capacity": exc.capacity,
+            "progress": exc.summary,
+            "remedy": (
+                "rerun with a larger --capacity, or --overflow spill "
+                "(lossless) / grow (auto-resize) / drop (lossy, counted)"
+            ),
+            "exit_code": EXIT_PRESSURE,
+        },
+    )
+
+
+def _unpack_words(packed: np.ndarray, n: int) -> list[np.ndarray]:
+    """numpy mirror of queue_push's unpack_words: [N, NW] i64 -> n i32."""
+    words: list[np.ndarray] = []
+    for i in range(packed.shape[-1]):
+        p = packed[..., i]
+        words.append((p >> 32).astype(np.int32))
+        if 2 * i + 1 < n:
+            words.append((p & 0xFFFFFFFF).astype(np.uint32).astype(np.int32))
+    return words[:n]
+
+
+def _pack_words_np(words: list[np.ndarray]) -> np.ndarray:
+    """numpy mirror of queue_push's pack_words: n i32[N] -> [N, NW] i64."""
+    out = []
+    for i in range(0, len(words), 2):
+        hi = words[i].astype(np.int64) << 32
+        lo = (
+            words[i + 1].astype(np.int64) & 0xFFFFFFFF
+            if i + 1 < len(words) else 0
+        )
+        out.append(hi | lo)
+    return np.stack(out, axis=-1)
+
+
+class PressureController:
+    """Host side of the spill path: reservoir + window-boundary refill.
+
+    One controller serves one (unsharded) engine; the sharded engine
+    refuses spill modes at build time (each shard would need its own
+    boundary synchronization — an open roadmap item).
+
+    The reservoir is a per-host list of binary min-heaps of
+    (time, packed_srcseq, packed_payload_words) tuples — exactly the
+    ring's record content, so harvest and refill never unpack payloads.
+    All counters are cumulative; the tracker diffs them per heartbeat.
+    """
+
+    def __init__(self, n_hosts: int, capacity: int, lookahead: int, *,
+                 mode: str = "spill", host0: int = 0,
+                 watermark: float = 0.75, n_args: int | None = None):
+        if mode not in ("spill", "grow"):
+            raise ValueError(f"controller modes are spill/grow, got {mode}")
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError(f"watermark must be in (0, 1], got {watermark}")
+        self.n_hosts = int(n_hosts)
+        self.capacity = int(capacity)
+        self.lookahead = int(lookahead)
+        self.mode = mode
+        self.host0 = int(host0)
+        self.watermark = float(watermark)
+        self._heaps: list[list] = [[] for _ in range(self.n_hosts)]
+        # cumulative host-side accounting (device-side lives in the ring)
+        self.n_harvested = np.zeros((self.n_hosts,), np.int64)
+        self.n_refilled = np.zeros((self.n_hosts,), np.int64)
+        self.n_overdue = 0
+        self.harvest_seconds = 0.0
+        self.boundaries = 0
+        self.grow_wanted = False
+        self._warned_overdue = False
+        self._n_args = n_args
+        # optional obs.TraceDrain: spill/refill rows are host-side
+        # moments, so the controller injects them as synthetic records
+        self.trace_drain = None
+        self._trace_len_arg = 0
+
+    def attach_trace(self, drain, len_arg: int = 0) -> None:
+        """Emit OP_SPILL / OP_REFILL records into an obs.TraceDrain
+        (len_arg = EngineConfig.trace_len_arg, for the plen column)."""
+        self.trace_drain = drain
+        self._trace_len_arg = int(len_arg)
+
+    def _n_args_of(self, nw: int) -> int:
+        return self._n_args if self._n_args is not None else 2 * nw - 1
+
+    def _inject(self, op: int, t, ss, pay, owner) -> None:
+        """Synthetic trace rows for a batch of (time, srcseq, payload)
+        reservoir records owned by local rows `owner`."""
+        pay = np.asarray(pay, np.int64).reshape(len(t), -1)
+        words = _unpack_words(pay, 1 + self._n_args_of(pay.shape[-1]))
+        la = 1 + self._trace_len_arg
+        ss = np.asarray(ss, np.int64)
+        self.trace_drain.inject(
+            time=t, src=(ss >> 32).astype(np.int32),
+            dst=np.asarray(owner, np.int32) + self.host0,
+            kind=words[0],
+            plen=words[la] if la < len(words) else np.zeros(len(t), np.int32),
+            seq=(ss & 0xFFFFFFFF).astype(np.uint32).astype(np.int32),
+            op=op, owner=owner, n_hosts=self.n_hosts,
+        )
+
+    # ------------------------------------------------------------- device
+    @staticmethod
+    @jax.jit
+    def _jit_reset(state):
+        ring = state.queues.spill
+        q = dataclasses.replace(
+            state.queues,
+            spill=dataclasses.replace(ring, wr=jnp.zeros_like(ring.wr)),
+        )
+        return dataclasses.replace(state, queues=q)
+
+    @staticmethod
+    @jax.jit
+    def _jit_probe(state):
+        """(fill, max_time, max_srcseq) per host — the refill loop's view
+        of the device queue, one small transfer instead of [H, C] pulls."""
+        q = state.queues
+        valid = q.time != TIME_INVALID
+        fill = jnp.sum(valid, axis=1, dtype=jnp.int32)
+        neg = jnp.iinfo(jnp.int64).min
+        maxt = jnp.max(jnp.where(valid, q.time, neg), axis=1)
+        ss = pack_srcseq(q.src, q.seq)
+        maxss = jnp.max(
+            jnp.where(valid & (q.time == maxt[:, None]), ss, neg), axis=1
+        )
+        return fill, maxt, maxss, state.now
+
+    @staticmethod
+    @jax.jit
+    def _jit_push(state, t, dst, src, seq, kind, args, host0):
+        ev = Events(time=t, dst=dst, src=src, seq=seq, kind=kind, args=args)
+        q = queue_push(state.queues, ev, t != TIME_INVALID, host0)
+        return dataclasses.replace(state, queues=q)
+
+    # ------------------------------------------------------------ harvest
+    def _harvest(self, state) -> Any:
+        """Move every ring record into the reservoir heaps; reset wr."""
+        ring = state.queues.spill
+        wr, t, ss, pay = jax.device_get(
+            (ring.wr, ring.time, ring.srcseq, ring.pay)
+        )
+        scap = t.shape[1] - self.capacity  # slack == queue capacity
+        kept = np.minimum(wr, scap)
+        for h in np.nonzero(kept > 0)[0]:
+            k = int(kept[h])
+            heap = self._heaps[h]
+            for i in range(k):
+                heapq.heappush(
+                    heap, (int(t[h, i]), int(ss[h, i]), tuple(pay[h, i]))
+                )
+            self.n_harvested[h] += k
+        if self.trace_drain is not None and kept.any():
+            hs = np.nonzero(kept > 0)[0]
+            sel = lambda a: np.concatenate(
+                [a[h, : kept[h]] for h in hs], axis=0
+            )
+            owner = np.concatenate(
+                [np.full((int(kept[h]),), h, np.int32) for h in hs]
+            )
+            self._inject(OP_SPILL, sel(t), sel(ss), sel(pay), owner)
+        return self._jit_reset(state)
+
+    # ------------------------------------------------------------- refill
+    def _collect(self, fill, maxt, maxss, horizon):
+        """Pop refill candidates: everything the total order demands
+        (key below the device max), everything due before the horizon,
+        then a top-up to the watermark fill."""
+        target = max(1, int(self.watermark * self.capacity))
+        cand = {"t": [], "dst": [], "src": [], "seq": [], "kind": [],
+                "args": []}
+        per_host = np.zeros((self.n_hosts,), np.int64)
+        n_args = self._n_args
+        for h in range(self.n_hosts):
+            heap = self._heaps[h]
+            if not heap:
+                continue
+            cnt = 0
+            while heap and cnt < self.capacity:
+                t, ss, pay = heap[0]
+                demand = fill[h] > 0 and (t, ss) < (
+                    int(maxt[h]), int(maxss[h])
+                )
+                due = t < horizon
+                topup = int(fill[h]) + cnt < target
+                if not (demand or due or topup):
+                    break
+                heapq.heappop(heap)
+                pw = np.asarray(pay, np.int64)[None, :]
+                if n_args is None:
+                    n_args = 2 * pw.shape[1] - 1  # kind + args words
+                words = _unpack_words(pw, 1 + n_args)
+                cand["t"].append(t)
+                cand["dst"].append(self.host0 + h)
+                cand["src"].append(int(ss) >> 32)
+                cand["seq"].append(np.int64(ss) & 0xFFFFFFFF)
+                cand["kind"].append(int(words[0][0]))
+                cand["args"].append([int(w[0]) for w in words[1:]])
+                cnt += 1
+            per_host[h] += cnt
+        return cand, per_host
+
+    def boundary(self, state) -> Any:
+        """Harvest + refill at a window boundary; returns the new state.
+
+        Cheap when idle: one device_get of the [H] write cursor. Under
+        pressure, loops push+harvest until the device holds the per-host
+        smallest keys and the fill watermark is met (or the round bound
+        trips — counted, warned, never silent).
+        """
+        ring = state.queues.spill
+        if ring is None:
+            return state
+        self.boundaries += 1
+        wr = np.asarray(jax.device_get(ring.wr))
+        resident = sum(len(hp) for hp in self._heaps)
+        if not wr.any() and resident == 0:
+            return state
+        if self.mode == "grow" and wr.any():
+            # fresh device-side evictions since the last boundary (not a
+            # cumulative counter, and not reservoir drain-down: the flag
+            # re-arms only if the queue ACTUALLY overflows again after a
+            # grow, so capacity converges instead of doubling forever)
+            self.grow_wanted = True
+        t0 = _time.perf_counter()
+        if wr.any():
+            state = self._harvest(state)
+        for _ in range(_MAX_REFILL_ROUNDS):
+            if not any(self._heaps):
+                break
+            fill, maxt, maxss, now = jax.device_get(self._jit_probe(state))
+            horizon = int(now) + self.lookahead
+            cand, per_host = self._collect(fill, maxt, maxss, horizon)
+            n = len(cand["t"])
+            if n == 0:
+                break
+            if self.trace_drain is not None:
+                la = self._trace_len_arg
+                self.trace_drain.inject(
+                    time=np.asarray(cand["t"], np.int64),
+                    src=np.asarray(cand["src"], np.int32),
+                    dst=np.asarray(cand["dst"], np.int32),
+                    kind=np.asarray(cand["kind"], np.int32),
+                    plen=np.asarray(
+                        [a[la] if la < len(a) else 0 for a in cand["args"]],
+                        np.int32,
+                    ),
+                    seq=np.asarray(cand["seq"], np.uint32).astype(np.int32),
+                    op=OP_REFILL,
+                    owner=np.asarray(cand["dst"], np.int32) - self.host0,
+                    n_hosts=self.n_hosts,
+                )
+            # bucket the push batch so jit re-traces O(log) times, not
+            # once per distinct candidate count
+            m = 64
+            while m < n:
+                m *= 2
+            n_args = len(cand["args"][0])
+            tt = np.full((m,), TIME_INVALID, np.int64)
+            dst = np.zeros((m,), np.int32)
+            src = np.zeros((m,), np.int32)
+            seq = np.zeros((m,), np.int32)
+            kind = np.zeros((m,), np.int32)
+            args = np.zeros((m, n_args), np.int32)
+            tt[:n] = cand["t"]
+            dst[:n] = cand["dst"]
+            src[:n] = cand["src"]
+            seq[:n] = np.asarray(cand["seq"], np.uint32).astype(np.int32)
+            kind[:n] = cand["kind"]
+            args[:n] = cand["args"]
+            state = self._jit_push(
+                state, jnp.asarray(tt), jnp.asarray(dst), jnp.asarray(src),
+                jnp.asarray(seq), jnp.asarray(kind), jnp.asarray(args),
+                jnp.asarray(self.host0, jnp.int32),
+            )
+            self.n_refilled += per_host
+            # refill may evict displaced larger keys back into the ring:
+            # harvest them immediately so the reservoir invariant holds
+            wr = np.asarray(jax.device_get(state.queues.spill.wr))
+            if wr.any():
+                state = self._harvest(state)
+            else:
+                # nothing displaced: the watermark pass is complete
+                break
+        self._check_overdue(state)
+        self.harvest_seconds += _time.perf_counter() - t0
+        return state
+
+    def _check_overdue(self, state) -> None:
+        """Count reservoir events whose time is already behind the
+        frontier — they missed their execution window (per-host demand
+        exceeded capacity so badly that eight refill rounds could not
+        seat them), the one regime spill cannot make lossless.
+
+        Deliberately `t < now`, not `t < now + lookahead`: events due
+        inside the *next* window normally still refill in time via the
+        demand rule (they displace larger device keys), so the wider
+        horizon would count events that go on to execute correctly."""
+        now = int(jax.device_get(state.now))
+        overdue = sum(
+            1 for hp in self._heaps for rec in hp if rec[0] < now
+        )
+        if overdue and not self._warned_overdue:
+            self._warned_overdue = True
+            print(
+                f"shadow_tpu pressure: {overdue} reservoir events are "
+                "behind the simulation frontier and could not be seated "
+                "on device — per-host demand exceeds --capacity; results "
+                "may diverge from an unbounded run (use --overflow grow "
+                "or a larger --capacity)",
+                file=sys.stderr, flush=True,
+            )
+        self.n_overdue += overdue
+
+    # ------------------------------------------------------------ queries
+    def resident(self) -> np.ndarray:
+        return np.asarray([len(hp) for hp in self._heaps], np.int64)
+
+    def reservoir_min_keys(self) -> np.ndarray:
+        """[H] smallest reservoir time per host (i64 max when empty) —
+        what the --validate pressure invariant compares device keys to."""
+        out = np.full((self.n_hosts,), np.iinfo(np.int64).max, np.int64)
+        for h, hp in enumerate(self._heaps):
+            if hp:
+                out[h] = hp[0][0]
+        return out
+
+    def snapshot(self, state) -> dict:
+        """Cumulative pressure counters (device + host) for telemetry."""
+        ring = state.queues.spill
+        if ring is None:
+            return {}
+        spilled, lost, hwm, wr = jax.device_get(
+            (ring.n_spilled, ring.n_lost, ring.fill_hwm, ring.wr)
+        )
+        return {
+            "spilled": int(np.sum(spilled)),
+            "spill_lost": int(np.sum(lost)),
+            "fill_hwm": int(np.max(hwm)) if hwm.size else 0,
+            "pending": int(np.sum(np.minimum(wr, ring.time.shape[1]
+                                             - self.capacity))),
+            "refilled": int(np.sum(self.n_refilled)),
+            "resident": int(np.sum(self.resident())),
+            "overdue": int(self.n_overdue),
+            "harvest_seconds": float(self.harvest_seconds),
+        }
+
+    # ------------------------------------------------- checkpoint support
+    def serialize(self) -> dict[str, np.ndarray]:
+        """Reservoir + counters as flat arrays for the checkpoint's extra
+        section, so `--resume` is bit-exact mid-pressure. Heap contents
+        are stored sorted: rebuilding a heap from sorted input yields
+        identical pop order, which is all determinism needs."""
+        counts = self.resident()
+        offsets = np.zeros((self.n_hosts + 1,), np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        total = int(offsets[-1])
+        nw = 1  # placeholder width when empty
+        for hp in self._heaps:
+            if hp:
+                nw = len(hp[0][2])
+                break
+        t = np.zeros((total,), np.int64)
+        ss = np.zeros((total,), np.int64)
+        pay = np.zeros((total, nw), np.int64)
+        for h, hp in enumerate(self._heaps):
+            for i, rec in enumerate(sorted(hp)):
+                j = int(offsets[h]) + i
+                t[j], ss[j] = rec[0], rec[1]
+                pay[j] = rec[2]
+        return {
+            "reservoir_offsets": offsets,
+            "reservoir_time": t,
+            "reservoir_srcseq": ss,
+            "reservoir_pay": pay,
+            "n_harvested": self.n_harvested.copy(),
+            "n_refilled": self.n_refilled.copy(),
+            "n_overdue": np.asarray(self.n_overdue, np.int64),
+        }
+
+    def restore(self, extra: dict) -> None:
+        offsets = np.asarray(extra["reservoir_offsets"])
+        t = np.asarray(extra["reservoir_time"])
+        ss = np.asarray(extra["reservoir_srcseq"])
+        pay = np.asarray(extra["reservoir_pay"])
+        self._heaps = [[] for _ in range(self.n_hosts)]
+        for h in range(self.n_hosts):
+            lo, hi = int(offsets[h]), int(offsets[h + 1])
+            self._heaps[h] = [
+                (int(t[j]), int(ss[j]), tuple(int(w) for w in pay[j]))
+                for j in range(lo, hi)
+            ]
+            heapq.heapify(self._heaps[h])
+        self.n_harvested = np.asarray(extra["n_harvested"]).copy()
+        self.n_refilled = np.asarray(extra["n_refilled"]).copy()
+        self.n_overdue = int(extra["n_overdue"])
+
+
+def run_with_spill(engine, state, stop, controller: PressureController,
+                   host0: int = 0):
+    """Window-stepped run loop with boundary harvest/refill — the raw
+    engine analog of Simulation.run for spill modes (bench + tests)."""
+    step = jax.jit(engine.step_window)
+    stop = jnp.int64(stop)
+    h0 = jnp.asarray(host0, jnp.int32)
+    while int(jax.device_get(state.now)) < int(stop):
+        state = step(state, stop, h0)
+        state = controller.boundary(state)
+    return state

@@ -27,14 +27,33 @@ from typing import Any, Callable
 # Distinct exit codes so wrappers (sbatch scripts, k8s restart policies,
 # the test harness) can tell failure classes apart without parsing logs.
 # 75 = EX_TEMPFAIL (retryable: the run stalled, a resubmit may succeed),
-# 70 = EX_SOFTWARE (internal state corruption; do NOT blindly resume).
+# 70 = EX_SOFTWARE (internal state corruption; do NOT blindly resume),
+# 76 = EX_PROTOCOL-adjacent (queue pressure under --overflow strict: the
+#      run is healthy but its results would be lossy; rerun with a larger
+#      --capacity or a lossless overflow mode).
 EXIT_STALL = 75
 EXIT_INVARIANT = 70
+EXIT_PRESSURE = 76
 
 
 def signal_exit_code(signum: int) -> int:
     """Shell convention: a signal-terminated process exits 128+N."""
     return 128 + int(signum)
+
+
+def write_diagnostic_bundle(diag_dir: str, label: str, kind: str,
+                            payload: dict) -> str:
+    """Write a `<label>.<kind>.<pid>.json` diagnostic bundle — the same
+    artifact shape the Watchdog leaves on a stall, reusable by any
+    abnormal-exit path (the queue-pressure strict mode uses it so a
+    `--overflow strict` abort is diagnosable from disk alone)."""
+    pid = os.getpid()
+    os.makedirs(diag_dir, exist_ok=True)
+    path = os.path.join(diag_dir, f"{label}.{kind}.{pid}.json")
+    with open(path, "w") as f:
+        json.dump({"pid": pid, **payload}, f, indent=2, default=str)
+        f.write("\n")
+    return path
 
 
 class Watchdog:

@@ -144,6 +144,15 @@ class Simulation:
     # and summary() grows a "profile" key
     profiler: Any = None
 
+    # queue-overflow handling (docs/9-Queue-Pressure.md): "drop" keeps
+    # the historical counted-drop behavior (with strict_overflow's loud
+    # RuntimeError), "strict" raises QueuePressureError at the first
+    # drop, "spill"/"grow" run losslessly via the attached
+    # PressureController (runtime.pressure) — run() then steps window by
+    # window so the controller can harvest/refill at every boundary
+    overflow: str = "drop"
+    pressure: Any = None  # PressureController for spill/grow modes
+
     _jit_run: Any = None
     _jit_step: Any = None
 
@@ -199,17 +208,35 @@ class Simulation:
         semantics mid-run. Set strict_overflow=False to accept counted
         drops instead (they remain visible in queues.drops).
         """
-        if self._jit_run is None:
-            object.__setattr__(self, "_jit_run", self._wrap(self.engine.run))
         st = state if state is not None else self.state0
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
+        if self.pressure is not None:
+            # spill/grow: the controller must see every window boundary,
+            # or an evicted event could miss the window it is due in —
+            # so run window-stepped instead of one fused device loop
+            out = st
+            stop_i = int(stop)
+            while int(jax.device_get(out.now)) < stop_i:
+                out = self.step_window(out, stop_i)
+                out = self.pressure.boundary(out)
+            return out
+        if self._jit_run is None:
+            object.__setattr__(self, "_jit_run", self._wrap(self.engine.run))
         if self.profiler is not None:
             with self.profiler.phase("step"):
                 out = self._jit_run(st, stop)
                 out.now.block_until_ready()
         else:
             out = self._jit_run(st, stop)
-        if self.strict_overflow:
+        if self.overflow == "strict":
+            drops = int(jax.device_get(out.queues.drops.sum()))
+            if drops > 0:
+                from shadow_tpu.runtime.pressure import QueuePressureError
+
+                raise QueuePressureError(
+                    drops, self.engine.cfg.capacity, self.summary(out)
+                )
+        elif self.strict_overflow:
             drops = int(jax.device_get(out.queues.drops.sum()))
             if drops > 0:
                 raise RuntimeError(
@@ -245,6 +272,11 @@ class Simulation:
         out = state_summary(state)
         if self.profiler is not None:
             out["profile"] = self.profiler.summary()
+        if self.pressure is not None:
+            snap = self.pressure.snapshot(state)
+            out["refilled"] = snap.get("refilled", 0)
+            out["reservoir"] = snap.get("resident", 0)
+            out["overdue"] = snap.get("overdue", 0)
         return out
 
 
@@ -450,6 +482,8 @@ def build_simulation(
     shape_bucket: bool = True,
     trace: int = 0,
     profiler: Any = None,
+    overflow: str = "drop",
+    spill_len: int = 0,
 ) -> Simulation:
     """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
 
@@ -461,6 +495,22 @@ def build_simulation(
     follow the locality layout, so single-vs-sharded comparisons must
     match hosts by NAME, not position.
     """
+    from shadow_tpu.runtime.pressure import OVERFLOW_MODES
+
+    if overflow not in OVERFLOW_MODES:
+        raise ValueError(
+            f"overflow must be one of {OVERFLOW_MODES}, got {overflow!r}"
+        )
+    if overflow in ("spill", "grow") and mesh is not None and (
+        int(mesh.devices.size) > 1
+    ):
+        # the reservoir's window-boundary harvest would need a cross-
+        # shard barrier protocol the controller doesn't speak yet; fail
+        # loudly instead of silently losing events (repo-wide principle)
+        raise ValueError(
+            f"--overflow {overflow} is not supported on sharded meshes "
+            "yet; use strict or drop (or run unsharded)"
+        )
     if registry is None:
         registry = default_registry()
     topo = Topology.from_graphml(cfg.topology_source())
@@ -811,11 +861,18 @@ def build_simulation(
                  (A_ACK, A_WND, A_AUX, A_SACK0, A_SACK1))
     from shadow_tpu.transport.stack import A_LEN as _A_LEN
 
+    # spill ring sizing: default 4x capacity of record slots absorbs the
+    # worst bursts seen in the skew benchmarks with room to spare; the
+    # ring reports (never hides) overflow via n_lost if undersized
+    spill = 0
+    if overflow in ("spill", "grow"):
+        spill = int(spill_len) if spill_len > 0 else 4 * capacity
     ecfg = EngineConfig(
         n_hosts=per_shard, capacity=capacity, lookahead=lookahead,
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
         axis_name=axis_name, n_shards=n_shards, burst=burst,
         trace=int(trace), trace_len_arg=int(_A_LEN),
+        spill=spill,
     )
     network = topo.build_network(host_vertex)
     # per-KIND CPU charges: a model may declare cycle costs for specific
@@ -950,6 +1007,14 @@ def build_simulation(
             f"hosts disagree on pcapdir ({sorted(pcap_dirs)}); captures "
             "share one directory per run"
         )
+    pressure = None
+    if overflow in ("spill", "grow"):
+        from shadow_tpu.runtime.pressure import PressureController
+
+        pressure = PressureController(
+            n_hosts, capacity, lookahead, mode=overflow,
+            n_args=N_PKT_ARGS,
+        )
     return Simulation(
         engine=eng, state0=st0, stop_ns=int(cfg.stoptime * SECOND),
         dns=dns, topo=topo, names=[h.name for h in hosts], app=model,
@@ -959,6 +1024,8 @@ def build_simulation(
         kind_names=tuple(kind_names),
         faults=faults,
         profiler=profiler,
+        overflow=overflow,
+        pressure=pressure,
     )
 
 

@@ -52,6 +52,14 @@ TRACE_FIELDS = (
     "fault_drop_records", "lost_records",
 )
 
+# whole-run [pressure] rows (only with --overflow spill/grow): one
+# aggregate interval row — harvest_seconds is wall clock, the rest are
+# event counts / the high-water queue fill
+PRESSURE_FIELDS = (
+    "hosts_pressured", "fill_hwm", "spilled", "refilled",
+    "spill_lost", "reservoir_resident", "overdue", "harvest_seconds",
+)
+
 
 def parse_lines(lines) -> dict:
     nodes: dict[str, dict] = {}
@@ -61,6 +69,9 @@ def parse_lines(lines) -> dict:
     trace: dict[str, dict] = {}
     supervisor: dict[str, list] = {
         "ticks": [], **{f: [] for f in SUPERVISOR_FIELDS}
+    }
+    pressure: dict[str, list] = {
+        "ticks": [], **{f: [] for f in PRESSURE_FIELDS}
     }
     for line in lines:
         if "[shadow-heartbeat] [node] " in line:
@@ -128,6 +139,18 @@ def parse_lines(lines) -> dict:
             node["ticks"].append(int(parts[0]))
             for f, v in zip(TRACE_FIELDS, parts[2:]):
                 node[f].append(int(v))
+        elif "[shadow-heartbeat] [pressure] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [pressure] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 1 + len(PRESSURE_FIELDS):
+                continue
+            pressure["ticks"].append(int(parts[0]))
+            for f, v in zip(PRESSURE_FIELDS[:-1], parts[1:-1]):
+                pressure[f].append(int(v))
+            # harvest_seconds is wall clock; strip_log may blank it
+            pressure["harvest_seconds"].append(
+                float(parts[-1]) if parts[-1] else None
+            )
         elif "[shadow-heartbeat] [supervisor] " in line:
             csv = line.rsplit(
                 "[shadow-heartbeat] [supervisor] ", 1
@@ -144,7 +167,8 @@ def parse_lines(lines) -> dict:
             )
             supervisor["checkpoints_written"].append(int(parts[5]))
     return {"nodes": nodes, "sockets": sockets, "ram": ram,
-            "faults": faults, "trace": trace, "supervisor": supervisor}
+            "faults": faults, "trace": trace, "supervisor": supervisor,
+            "pressure": pressure}
 
 
 def main(argv=None) -> int:

@@ -174,6 +174,33 @@ def make_figures(stats: dict, outdir: str, fmt: str = "png") -> list[str]:
         ax.legend(loc="upper left")
         save(fig, "shadow_tpu.supervisor")
 
+    # 7. queue pressure — spill/refill flow and the reservoir footprint
+    # (the [pressure] section only appears under --overflow spill/grow,
+    # so this figure is conditional like the fault timeline)
+    pres = stats.get("pressure", {})
+    if pres.get("ticks"):
+        fig, (ax, ax2) = plt.subplots(2, 1, figsize=(8, 6), sharex=True)
+        xs = pres["ticks"]
+        for field, label in (("spilled", "spilled"),
+                             ("refilled", "refilled"),
+                             ("spill_lost", "ring lost"),
+                             ("overdue", "overdue")):
+            ys = pres.get(field, [])
+            if any(ys):
+                ax.plot(xs, ys, label=label)
+        ax.set_ylabel("events / interval")
+        ax.set_yscale("symlog")
+        ax.set_title("queue pressure")
+        ax.legend()
+        ax2.plot(xs, pres.get("reservoir_resident", []),
+                 label="reservoir resident")
+        ax2.plot(xs, pres.get("fill_hwm", []), linestyle="--",
+                 label="device fill high-water")
+        ax2.set_xlabel("sim time (s)")
+        ax2.set_ylabel("events")
+        ax2.legend()
+        save(fig, "shadow_tpu.pressure")
+
     return written
 
 

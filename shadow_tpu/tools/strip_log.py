@@ -35,6 +35,11 @@ _SUPERVISOR = re.compile(
     r"(\[shadow-heartbeat\] \[supervisor\] \d+,\d+,)"
     r"[0-9.]*,[0-9.]*,[0-9.]*(,\d+)$"
 )
+# [pressure] rows are sim-determined except the trailing harvest
+# wall-clock column
+_PRESSURE = re.compile(
+    r"(\[shadow-heartbeat\] \[pressure\] (?:\d+,){7}\d+,)[0-9.]*$"
+)
 
 
 def strip_line(line: str) -> str | None:
@@ -48,11 +53,14 @@ def strip_line(line: str) -> str | None:
         if isinstance(obj, dict):
             for k in _WALL_KEYS:
                 obj.pop(k, None)
+            if isinstance(obj.get("pressure"), dict):
+                obj["pressure"].pop("harvest_seconds", None)
             return json.dumps(obj, sort_keys=True)
     # progress/timing diagnostics are wall-clock noise
     if "compile" in s and "second" in s:
         return None
     s = _SUPERVISOR.sub(r"\g<1>W,W,W\g<2>", s)
+    s = _PRESSURE.sub(r"\g<1>W", s)
     return _HEX_ADDR.sub("0xADDR", s)
 
 

@@ -42,8 +42,13 @@ import numpy as np
 # v3: EngineState.fault_epoch + fault Stats counters.
 # v4: per-leaf CRC32s in the header. Loading still accepts v3 (same tree
 # semantics, just no integrity data to verify against).
-FORMAT_VERSION = 4
-_LOADABLE_VERSIONS = (3, 4)
+# v5: optional named "extra" arrays outside the state tree (the pressure
+# reservoir rides here so --resume is bit-exact mid-pressure), and
+# EventQueue.drops widened i32 -> i64. Loading still accepts v3/v4: an
+# integer leaf whose checkpoint dtype is narrower than the template's is
+# widened in place (lossless), so pre-widening checkpoints keep resuming.
+FORMAT_VERSION = 5
+_LOADABLE_VERSIONS = (3, 4, 5)
 
 
 def _leaf_paths(tree: Any) -> list[str]:
@@ -92,15 +97,23 @@ def checkpoint_generations(path: str) -> list[str]:
 
 
 def save_checkpoint(path: str, state: Any, meta: dict | None = None,
-                    keep: int = 1) -> None:
+                    keep: int = 1,
+                    extra: dict[str, np.ndarray] | None = None) -> None:
     """Write `state` (any pytree of arrays) to `path` as .npz.
 
     `keep > 1` rotates: the previous `path` becomes `path.1` (and so on
     up to `path.{keep-1}`) before the new file lands, so a corrupted
     newest generation never strands the run without a fallback.
+
+    `extra` carries named host-side arrays that are not part of the
+    device state tree (the pressure reservoir, PressureController
+    .serialize()); they are CRC'd like leaves but excluded from the
+    template structure match on load, so the same checkpoint loads with
+    or without a controller attached.
     """
     leaves, _ = jax.tree_util.tree_flatten(state)
     leaves = [np.asarray(x) for x in jax.device_get(leaves)]
+    extra = {k: np.asarray(v) for k, v in (extra or {}).items()}
     header = {
         "format_version": FORMAT_VERSION,
         "n_leaves": len(leaves),
@@ -108,9 +121,11 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None,
         "shapes": [list(np.shape(x)) for x in leaves],
         "dtypes": [str(x.dtype) for x in leaves],
         "crc32": [_crc(x) for x in leaves],
+        "extra": {k: _crc(v) for k, v in sorted(extra.items())},
         "meta": meta or {},
     }
     arrs = {f"leaf_{i}": x for i, x in enumerate(leaves)}
+    arrs.update({f"extra_{k}": v for k, v in extra.items()})
     arrs["__header__"] = np.frombuffer(
         json.dumps(header).encode("utf-8"), dtype=np.uint8
     )
@@ -175,7 +190,33 @@ def verify_checkpoint(path: str) -> dict:
                     f"stored {want:#010x}, computed {got:#010x} — the file "
                     "was damaged after it was written"
                 )
+    if header.get("extra"):
+        for name, arr in read_extra(path).items():
+            want = header["extra"][name]
+            got = _crc(arr)
+            if got != want:
+                raise ValueError(
+                    f"checkpoint {path!r}: CRC mismatch on extra {name!r}: "
+                    f"stored {want:#010x}, computed {got:#010x} — the file "
+                    "was damaged after it was written"
+                )
     return header.get("meta", {})
+
+
+def read_extra(path: str) -> dict[str, np.ndarray]:
+    """The checkpoint's named extra arrays (empty for v3/v4 files)."""
+    try:
+        with np.load(path) as data:
+            header = json.loads(bytes(data["__header__"]).decode("utf-8"))
+            return {
+                k: data[f"extra_{k}"] for k in header.get("extra", {})
+            }
+    except (zipfile.BadZipFile, KeyError, EOFError, OSError, ValueError,
+            json.JSONDecodeError) as e:
+        raise ValueError(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e})"
+        ) from e
 
 
 def find_resume_checkpoint(path: str):
@@ -239,7 +280,14 @@ def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:
             np.asarray(tmpl).dtype if not hasattr(tmpl, "dtype")
             else tmpl.dtype
         )
-        if arr.shape != want_shape or str(arr.dtype) != str(want_dtype):
+        widen = (
+            arr.shape == want_shape
+            and str(arr.dtype) != str(want_dtype)
+            and arr.dtype.kind == np.dtype(want_dtype).kind == "i"
+            and arr.dtype.itemsize < np.dtype(want_dtype).itemsize
+        )
+        if (arr.shape != want_shape
+                or str(arr.dtype) != str(want_dtype)) and not widen:
             raise ValueError(
                 f"leaf {i} ({pth}): checkpoint {arr.shape}/{arr.dtype} vs "
                 f"template {want_shape}/{want_dtype}"
@@ -249,6 +297,74 @@ def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:
                 f"checkpoint {path!r}: CRC mismatch on leaf {i} ({pth}) — "
                 "the file was damaged after it was written"
             )
+        if widen:
+            # dtype migration (v4 -> v5 widened EventQueue.drops to i64):
+            # CRC is verified against the stored bytes above, THEN the
+            # lossless int widening brings the leaf to the template dtype
+            arr = arr.astype(want_dtype)
         new_leaves.append(jax.numpy.asarray(arr))
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return state, header.get("meta", {})
+
+
+def transfer_state(state: Any, template: Any) -> Any:
+    """Carry `state` into the (larger) shapes of `template` — the
+    `--overflow grow` re-templating path: the engine is rebuilt with
+    doubled queue capacity and the live state moves across mid-run.
+
+    Leaves are matched by tree path (both trees must have identical
+    structure). Where a template leaf is longer along some axes, the
+    state leaf is padded at the END of each grown axis — correct for
+    every capacity-sized array here because the queue invariant keeps
+    occupied slots in a contiguous sorted prefix (empties last), and the
+    spill ring's occupancy is a prefix below its write cursor (the
+    driver harvests the ring before growing, so the cursor is zero
+    anyway). Pad value: TIME_INVALID for leaves whose path ends in
+    `.time` (empty-slot sentinel), zero otherwise. Integer leaves are
+    widened to the template dtype when needed; shrinking any axis or
+    narrowing any dtype is refused loudly.
+    """
+    s_flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    t_paths = _leaf_paths(template)
+    s_paths = [jax.tree_util.keystr(p) for p, _ in s_flat]
+    if s_paths != t_paths:
+        diff = [f"  {a} (state) vs {b} (template)"
+                for a, b in zip(s_paths, t_paths) if a != b]
+        raise ValueError(
+            "transfer_state: tree structure differs:\n" + "\n".join(diff[:10])
+        )
+    time_invalid = np.iinfo(np.int64).max
+    out = []
+    for pth, (src, tmpl) in zip(t_paths, zip(
+            (leaf for _, leaf in s_flat), t_leaves)):
+        arr = np.asarray(jax.device_get(src))
+        want_shape = tuple(np.shape(tmpl))
+        want_dtype = np.dtype(
+            tmpl.dtype if hasattr(tmpl, "dtype") else np.asarray(tmpl).dtype
+        )
+        if arr.dtype != want_dtype:
+            if not (arr.dtype.kind == want_dtype.kind == "i"
+                    and arr.dtype.itemsize < want_dtype.itemsize):
+                raise ValueError(
+                    f"transfer_state: leaf {pth}: cannot convert "
+                    f"{arr.dtype} -> {want_dtype}"
+                )
+            arr = arr.astype(want_dtype)
+        if arr.shape != want_shape:
+            if arr.ndim != len(want_shape) or any(
+                a > w for a, w in zip(arr.shape, want_shape)
+            ):
+                raise ValueError(
+                    f"transfer_state: leaf {pth}: cannot shrink "
+                    f"{arr.shape} -> {want_shape}"
+                )
+            fill = (
+                time_invalid if pth.endswith(".time")
+                and want_dtype == np.int64 else 0
+            )
+            grown = np.full(want_shape, fill, want_dtype)
+            grown[tuple(slice(0, a) for a in arr.shape)] = arr
+            arr = grown
+        out.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -62,6 +62,17 @@ TRACE_HEADER = (
     "exec-records,send-records,net-drop-records,fault-drop-records,"
     "lost-records"
 )
+# queue-pressure telemetry (only with --overflow spill/grow): one
+# aggregate row per interval — how many hosts hit the spill path, the
+# peak queue fill, interval spill/refill counts, events lost to ring
+# overflow (0 unless the ring is undersized), events resident in the
+# host reservoir, and harvest wall time (stripped from determinism
+# diffs by tools/strip_log.py like every wall-clock column)
+PRESSURE_HEADER = (
+    "[shadow-heartbeat] [pressure-header] time-seconds,"
+    "hosts-pressured,fill-hwm,spilled,refilled,spill-lost,"
+    "reservoir-resident,overdue,harvest-seconds"
+)
 
 
 @dataclasses.dataclass
@@ -193,7 +204,8 @@ class Tracker:
                  log_info: tuple[str, ...] = ("node",),
                  info_of: dict[str, tuple[str, ...]] | None = None,
                  level_of: dict[str, str] | None = None,
-                 faults: Any = None, trace: Any = None):
+                 faults: Any = None, trace: Any = None,
+                 pressure: Any = None):
         self.names = names
         self.logger = logger
         self.log_info = log_info
@@ -201,6 +213,10 @@ class Tracker:
         self.level_of = level_of or {}
         self.faults = faults  # CompiledFaults -> emit the [fault] section
         self.trace = trace  # obs.TraceDrain -> emit the [trace] section
+        # runtime.pressure.PressureController -> emit the [pressure]
+        # section (cumulative snapshots diffed per interval, like prev)
+        self.pressure = pressure
+        self._prev_pressure: dict | None = None
         self.prev = Snapshot.zero(len(names))
         # None until the first heartbeat lands; afterwards the guard in
         # heartbeat() drops zero-length (or backwards) intervals so a
@@ -230,6 +246,9 @@ class Tracker:
                 self.logger.log(sim_ns, "tracker", "message", FAULT_HEADER)
             if self.trace is not None:
                 self.logger.log(sim_ns, "tracker", "message", TRACE_HEADER)
+            if self.pressure is not None:
+                self.logger.log(sim_ns, "tracker", "message",
+                                PRESSURE_HEADER)
             self._emitted_headers = True
         t_s = sim_ns // 1_000_000_000
         p = self.prev
@@ -266,8 +285,37 @@ class Tracker:
             self._fault_lines(cur, sim_ns, t_s)
         if self.trace is not None:
             self._trace_lines(sim_ns, t_s)
+        if self.pressure is not None:
+            self._pressure_line(st, sim_ns, t_s)
         self.prev = cur
         self._prev_ns = sim_ns
+
+    def _pressure_line(self, st, sim_ns: int, t_s: int) -> None:
+        """One aggregate queue-pressure row per interval (like the
+        [supervisor] section: whole-run, not per-host — pressure is a
+        capacity-sizing signal, and the per-host detail lives in the
+        trace ops and the validator). Counters are cumulative on the
+        controller/ring; this diffs them against the previous beat."""
+        ring = getattr(st.queues, "spill", None)
+        if ring is None:
+            return
+        cur = self.pressure.snapshot(st)
+        n_spilled = np.array(jax.device_get(ring.n_spilled))
+        prev = self._prev_pressure or {}
+        prev_sp = prev.get("per_host_spilled")
+        d_sp = n_spilled - (prev_sp if prev_sp is not None else 0)
+        hosts_pressured = int((d_sp > 0).sum())
+        dd = lambda k: int(cur.get(k, 0)) - int(prev.get(k, 0))
+        self.logger.log(
+            sim_ns, "tracker", "message",
+            "[shadow-heartbeat] [pressure] "
+            f"{t_s},{hosts_pressured},{cur['fill_hwm']},"
+            f"{dd('spilled')},{dd('refilled')},{dd('spill_lost')},"
+            f"{cur['resident']},{dd('overdue')},"
+            f"{cur['harvest_seconds'] - prev.get('harvest_seconds', 0.0):.3f}",
+        )
+        cur["per_host_spilled"] = n_spilled
+        self._prev_pressure = cur
 
     def _trace_lines(self, sim_ns: int, t_s: int) -> None:
         """Exact per-host record counts from the device trace drain.

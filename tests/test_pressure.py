@@ -1,0 +1,357 @@
+"""Queue-pressure handling: spill / strict / grow / drop (runtime/pressure.py).
+
+Pins the tentpole guarantees of the lossless overflow layer:
+
+- `--overflow drop` is zero-cost — the lowered HLO and the state pytree
+  are byte-identical to a build that never heard of spilling (the same
+  discipline tests/test_trace_export.py pins for the trace ring);
+- a capacity-C run with spill finishes bit-identical to a capacity-2C
+  run without it (the headline acceptance criterion), and the
+  device-queue ∪ reservoir contents partition exactly;
+- the pre-existing eviction semantics stay pinned: largest-key eviction
+  commutes with batch splits and drop accounting is equal chained vs
+  batched;
+- strict mode exits 76 with a machine-readable diagnostic bundle;
+- checkpoints: v4 i32-drops files widen on load, the reservoir
+  round-trips bit-exact through the extras section, and
+  `transfer_state` (the grow path) carries live state into a doubled
+  capacity without losing determinism;
+- the --validate pressure invariants actually fire.
+"""
+
+import glob
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.core.events import EventQueue, queue_push
+from shadow_tpu.core.timebase import MILLISECOND, TIME_INVALID
+from shadow_tpu.models import phold
+from shadow_tpu.runtime.pressure import (
+    PressureController,
+    QueuePressureError,
+    run_with_spill,
+)
+
+H = 16
+CAP = 8
+STOP = 400 * MILLISECOND
+HOT = dict(hot_hosts=4, hot_weight=0.6, msgs_per_host=2)
+
+
+def _behavior(st):
+    """The behavioral leaves two runs must agree on (queue layout and
+    ring/stats shapes legitimately differ across capacities)."""
+    return jax.device_get((st.now, st.stats.n_executed, st.stats.n_emitted,
+                           st.hosts.n_received, st.src_seq))
+
+
+def _remaining(st):
+    """Per-host sorted (time, src, seq) of events still queued; accepts
+    either a full engine state or a bare EventQueue."""
+    qs = getattr(st, "queues", st)
+    t, s, q = jax.device_get((qs.time, qs.src, qs.seq))
+    return [
+        sorted((int(t[h, i]), int(s[h, i]), int(q[h, i]))
+               for i in range(t.shape[1]) if t[h, i] != TIME_INVALID)
+        for h in range(t.shape[0])
+    ]
+
+
+# --------------------------------------------------------------- zero cost
+
+def test_overflow_drop_is_zero_cost():
+    """spill=0 leaves no residue: leaf-free subtree, identical pytree
+    structure, byte-identical lowered HLO vs a default build — so drop
+    mode's compiled program and checkpoint leaf layout never change."""
+    eng0, init0 = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+    engz, initz = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
+                              spill=0)
+    engs, inits = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
+                              spill=64)
+    st0, stz, sts = init0(), initz(), inits()
+    assert st0.queues.spill is None and stz.queues.spill is None
+    assert sts.queues.spill is not None
+    assert len(jax.tree.leaves(st0)) == len(jax.tree.leaves(stz))
+    assert len(jax.tree.leaves(sts)) > len(jax.tree.leaves(st0))
+    assert jax.tree.structure(st0) == jax.tree.structure(stz)
+    stop = jnp.int64(STOP)
+    low0 = jax.jit(eng0.run).lower(st0, stop).as_text()
+    lowz = jax.jit(engz.run).lower(stz, stop).as_text()
+    lows = jax.jit(engs.run).lower(sts, stop).as_text()
+    assert low0 == lowz  # HLO op-for-op identical: zero cost when off
+    assert lows != low0
+
+
+# ------------------------------------------------------------ bit identity
+
+@pytest.fixture(scope="module")
+def spill_vs_2c():
+    """One pressured skew run in each mode, shared across assertions."""
+    eng2, init2 = phold.build(H, capacity=2 * CAP, **HOT)
+    st2 = jax.jit(eng2.run)(init2(), jnp.int64(STOP))
+
+    eng1, init1 = phold.build(H, capacity=CAP, **HOT)
+    st1 = jax.jit(eng1.run)(init1(), jnp.int64(STOP))
+
+    engs, inits = phold.build(H, capacity=CAP, spill=4 * CAP, **HOT)
+    ctrl = PressureController(H, CAP, engs.cfg.lookahead,
+                              n_args=phold.N_PHOLD_ARGS)
+    sts = run_with_spill(engs, inits(), STOP, ctrl)
+    return st2, st1, sts, ctrl
+
+
+def test_spill_is_bit_identical_to_double_capacity(spill_vs_2c):
+    st2, st1, sts, ctrl = spill_vs_2c
+    assert int(jax.device_get(st2.queues.drops.sum())) == 0, (
+        "reference 2C run must be drop-free for the comparison to bind"
+    )
+    assert int(jax.device_get(st1.queues.drops.sum())) > 0, (
+        "capacity C without spill must actually be pressured"
+    )
+    assert int(jax.device_get(sts.queues.drops.sum())) == 0
+    assert int(jax.device_get(sts.queues.spill.n_spilled.sum())) > 0
+    names = ("now", "n_executed", "n_emitted", "n_received", "src_seq")
+    for a, b, name in zip(_behavior(sts), _behavior(st2), names):
+        assert np.array_equal(a, b), f"spill-C diverged from 2C in {name}"
+
+
+def test_device_and_reservoir_partition_the_2c_queue(spill_vs_2c):
+    """At stop, device queue ∪ reservoir == the 2C run's queue, exactly,
+    per host — nothing lost, nothing duplicated, nothing invented."""
+    st2, _, sts, ctrl = spill_vs_2c
+    res = [
+        sorted((r[0], r[1] >> 32, int(np.int64(r[1]) & 0xFFFFFFFF))
+               for r in hp)
+        for hp in ctrl._heaps
+    ]
+    dev = _remaining(sts)
+    ref = _remaining(st2)
+    for h in range(H):
+        assert sorted(dev[h] + res[h]) == ref[h], f"host {h} partition"
+
+
+def test_reservoir_keys_dominate_device_keys(spill_vs_2c):
+    _, _, sts, ctrl = spill_vs_2c
+    t = jax.device_get(sts.queues.time)
+    res_min = ctrl.reservoir_min_keys()
+    for h in range(H):
+        valid = t[h][t[h] != TIME_INVALID]
+        if valid.size:
+            assert res_min[h] >= valid.max(), f"host {h} key inversion"
+
+
+# --------------------------------------------- pinned eviction semantics
+
+def _push_rows(q, rows, host0=0):
+    from tests.test_events import mk_events
+
+    return queue_push(q, mk_events(rows), jnp.ones(len(rows), bool), host0)
+
+
+def test_eviction_commutes_with_batch_splits():
+    """Pushing N events in one batch or in any chained split keeps the
+    same survivors (the capacity smallest keys) and the same drops."""
+    rows = [(t, 0, 0, t, 0) for t in (5, 9, 1, 7, 3, 8, 2)]
+    whole = _push_rows(EventQueue.create(1, 3), rows)
+    for cut in range(1, len(rows) - 1):
+        split = _push_rows(
+            _push_rows(EventQueue.create(1, 3), rows[:cut]), rows[cut:]
+        )
+        assert _remaining(split) == _remaining(whole), f"cut={cut}"
+        assert split.drops.tolist() == whole.drops.tolist(), f"cut={cut}"
+    assert _remaining(whole)[0] == [(1, 0, 1), (2, 0, 2), (3, 0, 3)]
+    assert whole.drops.tolist() == [4]
+
+
+def test_spill_ring_capture_commutes_with_batch_splits():
+    """With a ring attached the same splits also capture the SAME evicted
+    set (order within the ring may differ across splits; the harvested
+    content may not)."""
+
+    def spilled_set(q):
+        wr, t, ss = (np.asarray(x) for x in
+                     jax.device_get((q.spill.wr, q.spill.time,
+                                     q.spill.srcseq)))
+        k = min(int(wr[0]), t.shape[1])
+        return sorted((int(t[0, i]), int(ss[0, i])) for i in range(k))
+
+    rows = [(t, 0, 0, t, 0) for t in (5, 9, 1, 7, 3, 8, 2)]
+    whole = _push_rows(EventQueue.create(1, 3, spill=8), rows)
+    assert whole.drops.tolist() == [0]  # captured, not dropped
+    want = spilled_set(whole)
+    assert len(want) == 4
+    for cut in range(1, len(rows) - 1):
+        split = _push_rows(
+            _push_rows(EventQueue.create(1, 3, spill=8), rows[:cut]),
+            rows[cut:],
+        )
+        assert _remaining(split) == _remaining(whole), f"cut={cut}"
+        assert spilled_set(split) == want, f"cut={cut}"
+
+
+# ------------------------------------------------------------ strict mode
+
+def test_strict_mode_exits_76_with_bundle(tmp_path):
+    from shadow_tpu.cli import main
+    from shadow_tpu.runtime import EXIT_PRESSURE
+
+    rc = main([
+        "--test", "--stoptime", "4", "--capacity", "4",
+        "--overflow", "strict", "--diag-dir", str(tmp_path),
+    ])
+    assert rc == EXIT_PRESSURE == 76
+    bundles = glob.glob(str(tmp_path / "*.pressure.*.json"))
+    assert len(bundles) == 1
+    with open(bundles[0]) as f:
+        b = json.load(f)
+    assert b["exit_code"] == 76
+    assert b["would_drop"] > 0
+    assert b["capacity"] == 4
+    assert b["progress"]["queue_drops"] == b["would_drop"]
+    assert "--overflow spill" in b["remedy"]
+
+
+def test_strict_conflicts_with_legacy_flag():
+    from shadow_tpu.cli import main
+
+    rc = main(["--test", "--stoptime", "1", "--allow-queue-overflow",
+               "--overflow", "strict"])
+    assert rc == 2
+
+
+# ------------------------------------------------------------ checkpoints
+
+def test_v4_i32_drops_checkpoint_widens_on_load(tmp_path, monkeypatch):
+    from shadow_tpu.utils import checkpoint as cp
+
+    tree_v4 = {"drops": jnp.asarray([3, 0, 7], jnp.int32),
+               "x": jnp.arange(4, dtype=jnp.int64)}
+    path = str(tmp_path / "v4.npz")
+    monkeypatch.setattr(cp, "FORMAT_VERSION", 4)
+    cp.save_checkpoint(path, tree_v4)
+    monkeypatch.undo()
+
+    template = {"drops": jnp.zeros(3, jnp.int64),
+                "x": jnp.zeros(4, jnp.int64)}
+    loaded, _ = cp.load_checkpoint(path, template)
+    assert loaded["drops"].dtype == jnp.int64
+    assert loaded["drops"].tolist() == [3, 0, 7]
+
+
+def test_narrowing_load_still_rejected(tmp_path):
+    from shadow_tpu.utils import checkpoint as cp
+
+    path = str(tmp_path / "wide.npz")
+    cp.save_checkpoint(path, {"d": jnp.asarray([1, 2], jnp.int64)})
+    with pytest.raises(ValueError, match="int64"):
+        cp.load_checkpoint(path, {"d": jnp.zeros(2, jnp.int32)})
+
+
+def test_reservoir_serializes_through_checkpoint_extras(tmp_path):
+    """Mid-pressure state + reservoir through save/load/restore, then
+    both the original and the restored controller finish the run — the
+    final states and reservoirs must be bit-identical."""
+    from shadow_tpu.utils.checkpoint import (
+        load_checkpoint, read_extra, save_checkpoint,
+    )
+
+    # msgs_per_host high enough that hot-host demand exceeds capacity in
+    # steady state, so the reservoir is resident at the pause boundary
+    heavy = dict(HOT, msgs_per_host=8)
+    engs, inits = phold.build(H, capacity=CAP, spill=4 * CAP, **heavy)
+    ctrl = PressureController(H, CAP, engs.cfg.lookahead,
+                              n_args=phold.N_PHOLD_ARGS)
+    mid = run_with_spill(engs, inits(), STOP // 2, ctrl)
+    assert int(ctrl.resident().sum()) > 0, "need a populated reservoir"
+
+    path = str(tmp_path / "mid.npz")
+    save_checkpoint(path, mid, meta={"t": 1}, extra=ctrl.serialize())
+
+    restored_state, meta = load_checkpoint(path, inits())
+    assert meta == {"t": 1}
+    ctrl2 = PressureController(H, CAP, engs.cfg.lookahead,
+                               n_args=phold.N_PHOLD_ARGS)
+    ctrl2.restore(read_extra(path))
+    assert ctrl2.resident().tolist() == ctrl.resident().tolist()
+
+    fin_a = run_with_spill(engs, mid, STOP, ctrl)
+    fin_b = run_with_spill(engs, restored_state, STOP, ctrl2)
+    for a, b in zip(jax.tree.leaves(fin_a), jax.tree.leaves(fin_b)):
+        assert np.array_equal(jax.device_get(a), jax.device_get(b))
+    assert ctrl.serialize().keys() == ctrl2.serialize().keys()
+    for k, v in ctrl.serialize().items():
+        assert np.array_equal(v, ctrl2.serialize()[k]), k
+
+
+def test_transfer_state_grow_stays_bit_identical(tmp_path):
+    """The grow path end to end at engine level: run pressured at C,
+    re-template at 2C via transfer_state, drain the reservoir, finish —
+    behaviorally identical to a straight 2C run."""
+    from shadow_tpu.utils.checkpoint import transfer_state
+
+    eng2, init2 = phold.build(H, capacity=2 * CAP, **HOT)
+    ref = jax.jit(eng2.run)(init2(), jnp.int64(STOP))
+
+    engc, initc = phold.build(H, capacity=CAP, spill=4 * CAP, **HOT)
+    ctrl = PressureController(H, CAP, engc.cfg.lookahead, mode="grow",
+                              n_args=phold.N_PHOLD_ARGS)
+    st = run_with_spill(engc, initc(), STOP // 2, ctrl)
+    assert ctrl.grow_wanted, "skew at capacity C must request a grow"
+
+    engg, initg = phold.build(H, capacity=2 * CAP, spill=8 * CAP, **HOT)
+    st = transfer_state(st, initg())
+    ctrl.capacity = 2 * CAP
+    ctrl.grow_wanted = False
+    st = ctrl.boundary(st)
+    fin = run_with_spill(engg, st, STOP, ctrl)
+
+    assert int(jax.device_get(fin.queues.drops.sum())) == 0
+    names = ("now", "n_executed", "n_emitted", "n_received", "src_seq")
+    for a, b, name in zip(_behavior(fin), _behavior(ref), names):
+        assert np.array_equal(a, b), f"grown run diverged from 2C in {name}"
+
+
+def test_transfer_state_refuses_shrink():
+    from shadow_tpu.utils.checkpoint import transfer_state
+
+    _, init16 = phold.build(8, capacity=16, msgs_per_host=2)
+    _, init8 = phold.build(8, capacity=8, msgs_per_host=2)
+    with pytest.raises(ValueError, match="shrink"):
+        transfer_state(init16(), init8())
+
+
+# -------------------------------------------------------------- invariants
+
+def test_pressure_invariants_catch_violations(spill_vs_2c):
+    from shadow_tpu.runtime.invariants import check_state
+
+    _, _, sts, ctrl = spill_vs_2c
+    assert check_state(sts, pressure=ctrl) == []
+
+    # drops ran backwards
+    prev = np.asarray(jax.device_get(sts.queues.drops)) + 1
+    bad = check_state(sts, prev_drops=prev, pressure=ctrl)
+    assert any("ran backwards" in v for v in bad)
+
+    # reservoir key below a device key breaks the refill invariant
+    t = np.asarray(jax.device_get(sts.queues.time))
+    pressured = next(
+        h for h in range(H) if (t[h] != TIME_INVALID).any()
+    )
+    ctrl._heaps[pressured].insert(0, (0, 0, (0,)))
+    try:
+        bad = check_state(sts, pressure=ctrl)
+        assert any("reservoir" in v for v in bad)
+    finally:
+        ctrl._heaps[pressured].pop(0)
+
+
+def test_strict_error_carries_accounting():
+    e = QueuePressureError(17, 64, {"now_ns": 5})
+    assert e.drops == 17 and e.capacity == 64
+    assert e.summary == {"now_ns": 5}
+    assert "17" in str(e) and "--overflow spill" in str(e)
