@@ -51,4 +51,26 @@ for mode in drop spill grow strict; do
     echo "pressure_smoke_strict: unexpected exit $rc" >> "$S"
   fi
 done
+# static-analysis gate: shadowlint over the package plus the HLO
+# contract audit of every model config. The CLI's JSON report is the
+# stage's $R line; a nonzero exit means new findings or a budget breach.
+echo "=== lint start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"lint\"}" >> "$R"
+timeout 900 env JAX_PLATFORMS=cpu python -m shadow_tpu.tools.lint \
+  --hlo-audit all --output measure_lint.json 2>> "$S" \
+  && cat measure_lint.json >> "$R"
+echo "=== lint exit=$? $(date +%H:%M:%S)" >> "$S"
+# sanitizer smoke: interposer + driver as one ASan/UBSan executable
+# (the dlmopen plugin path cannot host a sanitized DSO — see
+# shadow_tpu/proc/native.py SANITIZE_FLAGS)
+echo "=== asan_smoke start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"asan_smoke\"}" >> "$R"
+timeout 300 python -c '
+import json
+from shadow_tpu.proc import native
+r = native.sanitizer_smoke()
+print(json.dumps({"ok": r["ok"], "returncode": r["returncode"]}))
+raise SystemExit(0 if r["ok"] else 1)
+' >> "$R" 2>> "$S"
+echo "=== asan_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 echo ALL_DONE >> "$S"

@@ -1,0 +1,39 @@
+"""Static analysis for the jitted hot path (docs/10-Static-Analysis.md).
+
+Two layers, both importable without JAX side effects beyond what the
+package already does at import:
+
+- `shadow_tpu.analysis.lint`: an AST linter flagging the JAX footguns
+  that have historically cost this codebase debugging time (tracer
+  branches, host materialization inside jit, i32 sim-time truncation,
+  PRNG key reuse, mutable default pytrees, unordered-iteration pytree
+  hazards), with a checked-in baseline so accepted findings don't
+  block the lint gate.
+- `shadow_tpu.analysis.hlo_audit`: lowers the engine for each model
+  config and checks the StableHLO text against declared contracts
+  (scatter budgets, custom-call allowlist, no host callbacks), plus
+  the centralized zero-cost check shared by the trace/pressure/faults
+  test suites.
+
+CLI: ``python -m shadow_tpu.tools.lint`` (JSON findings, baseline
+workflow, optional HLO audit).
+"""
+
+from shadow_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    lint_package,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    save_baseline,
+    split_new,
+)
+from shadow_tpu.analysis.hlo_audit import (  # noqa: F401
+    HloContract,
+    CONTRACTS,
+    assert_no_recompile,
+    assert_zero_cost,
+    audit_model,
+    audit_text,
+    ops_histogram,
+)

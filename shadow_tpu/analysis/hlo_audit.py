@@ -1,0 +1,277 @@
+"""HLO contract auditor: the lowering invariants, declared and checked.
+
+The paper's performance story rests on what the window loop lowers to
+(ROADMAP.md invariants): a single fused XLA program, sort-based queue
+maintenance with no scatter in the unsharded hot path, no host
+callbacks inside the loop, and byte-identical HLO when optional
+subsystems (trace ring, spill ring, faults) are off. Until now those
+were checked by ad-hoc string asserts copy-pasted across test files;
+this module makes them declared contracts:
+
+- `CONTRACTS` maps each model config to an `HloContract` (per-op
+  budgets, custom-call allowlist, host-callback ban). The raw phold
+  engine must be scatter-free; config-driven models get a small scatter
+  budget for the TCP accept/bind row-slot updates in `host/sockets.py`
+  (bounded, outside the per-event fast path).
+- `audit_model(name)` builds a tiny instance of the config, lowers
+  `Engine.run`, and returns violations against the contract.
+- `assert_no_recompile(fn, calls)` guards the one-program claim via
+  jit cache inspection.
+- `assert_zero_cost(base, off, on, stop)` is the single zero-cost
+  checker (leaf count + pytree structure + checkpoint leaf paths +
+  byte-identical lowered text) shared by the trace/pressure/faults
+  test suites.
+
+CLI: ``python -m shadow_tpu.tools.lint --hlo-audit all``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import Counter
+from typing import Any, Callable, Iterable
+
+_OP_RE = re.compile(r"\b(?:stablehlo|mhlo|chlo)\.([A-Za-z0-9_]+)")
+_CUSTOM_TARGET_RE = re.compile(r'call_target_name\s*=\s*"([^"]+)"')
+
+# Ops that move control to the host (or to an opaque callback) — never
+# acceptable inside the window loop under any budget.
+HOST_CALLBACK_OPS = frozenset({
+    "infeed", "outfeed", "send", "recv",
+})
+HOST_CALLBACK_TARGETS = (
+    "xla_python_cpu_callback",
+    "xla_python_gpu_callback",
+    "xla_ffi_python_cpu_callback",
+    "CallbackCustomCall",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class HloContract:
+    """Declared lowering budget for one model config.
+
+    `budgets` caps specific op counts (0 forbids outright); any op not
+    listed is unconstrained. `custom_call_allow` lists permitted
+    custom_call targets; every other target is a violation. Host
+    callbacks (infeed/outfeed/send/recv + python-callback custom
+    calls) are always forbidden.
+    """
+
+    name: str
+    budgets: dict  # op name -> max count
+    custom_call_allow: tuple = ()
+
+    def check(self, text: str) -> list[str]:
+        return audit_text(text, self)
+
+
+def ops_histogram(text: str) -> Counter:
+    """Count dialect ops (stablehlo/mhlo/chlo) in lowered IR text."""
+    return Counter(_OP_RE.findall(text))
+
+
+def custom_call_targets(text: str) -> list[str]:
+    return _CUSTOM_TARGET_RE.findall(text)
+
+
+def audit_text(text: str, contract: HloContract) -> list[str]:
+    """Check lowered IR text against a contract; [] means clean."""
+    hist = ops_histogram(text)
+    violations: list[str] = []
+    for op, cap in sorted(contract.budgets.items()):
+        n = hist.get(op, 0)
+        if n > cap:
+            violations.append(
+                f"{contract.name}: {n}x stablehlo.{op} exceeds budget "
+                f"{cap}")
+    for op in sorted(HOST_CALLBACK_OPS):
+        if hist.get(op, 0):
+            violations.append(
+                f"{contract.name}: host-transfer op stablehlo.{op} in "
+                f"lowered program")
+    targets = custom_call_targets(text)
+    for t in targets:
+        if t in HOST_CALLBACK_TARGETS:
+            violations.append(
+                f"{contract.name}: host-callback custom_call `{t}`")
+        elif t not in contract.custom_call_allow:
+            violations.append(
+                f"{contract.name}: custom_call target `{t}` not in "
+                f"allowlist {sorted(contract.custom_call_allow)}")
+    return violations
+
+
+# The raw engine (no socket stack) must stay scatter-free — the queue
+# is maintained by sorts alone (ROADMAP invariant). Config-driven
+# models lower one scatter per (host_row, slot) socket-table update
+# site in host/sockets.py and the app models (accept/bind/stream
+# bookkeeping): the count is structural — per traced update site, not
+# per host or per event — so it is pinned exactly at today's value per
+# config. A failing budget means a new scatter entered the window loop;
+# either hoist it to sort/where form or consciously raise the budget
+# here with a comment.
+def _budget(scatter: int) -> dict:
+    return {"scatter": scatter, "select_and_scatter": 0, "custom_call": 0}
+
+
+CONTRACTS: dict[str, HloContract] = {
+    "phold": HloContract("phold", _budget(0)),
+    "phold_net": HloContract("phold_net", _budget(8)),
+    "tgen": HloContract("tgen", _budget(22)),
+    "tor": HloContract("tor", _budget(14)),
+    "bitcoin": HloContract("bitcoin", _budget(42)),
+}
+
+
+# ----------------------------------------------------------- lowering
+
+
+def lower_text(run: Callable, state: Any, stop) -> str:
+    """StableHLO text of jit(run) lowered at (state, stop)."""
+    import jax
+
+    return jax.jit(run).lower(state, stop).as_text()
+
+
+def _build(name: str):
+    """(run, state, stop) for a tiny instance of a model config.
+
+    Sizes are the smallest that exercise the full drain/exchange path;
+    the audit checks op structure, which is size-independent.
+    """
+    import jax.numpy as jnp
+
+    if name == "phold":
+        from shadow_tpu.models import phold
+
+        eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+        return eng.run, init(), jnp.int64(5_000_000_000)
+
+    from shadow_tpu import examples
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.sim import build_simulation
+
+    if name == "phold_net":
+        text = examples.phold_example(8, msgs_per_host=2, stoptime=5)
+    elif name == "tgen":
+        text = examples.example_config()
+    elif name == "tor":
+        text = examples.tor_example(n_relays_per_class=2, n_clients=4,
+                                    n_servers=2, stoptime=5)
+    elif name == "bitcoin":
+        text = examples.bitcoin_example(n_nodes=8, blocks=1, stoptime=5)
+    else:
+        raise KeyError(f"unknown model config `{name}` "
+                       f"(have {sorted(CONTRACTS)})")
+    sim = build_simulation(parse_config(text), seed=3)
+    return sim.engine.run, sim.state0, jnp.int64(sim.stop_ns)
+
+
+def audit_model(name: str) -> tuple[str, list[str]]:
+    """Lower one model config and audit it. Returns (text, violations)."""
+    contract = CONTRACTS[name]
+    run, state, stop = _build(name)
+    text = lower_text(run, state, stop)
+    return text, audit_text(text, contract)
+
+
+def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
+    """Audit several configs; per-config dict has `violations` and the
+    op histogram (for the JSON report)."""
+    out: dict[str, dict] = {}
+    for name in (names or sorted(CONTRACTS)):
+        text, violations = audit_model(name)
+        hist = ops_histogram(text)
+        out[name] = {
+            "ok": not violations,
+            "violations": violations,
+            "ops": {k: hist[k] for k in sorted(hist) if k in
+                    ("scatter", "sort", "while", "gather", "custom_call",
+                     "all_to_all", "infeed", "outfeed", "send", "recv")},
+        }
+    return out
+
+
+# ----------------------------------------------------- recompile guard
+
+
+def assert_no_recompile(fn: Callable, calls: Iterable[tuple]) -> int:
+    """Call jit(fn) across `calls` (same shapes/dtypes expected) and
+    assert the jit cache holds exactly one entry — the one-program
+    claim, checked rather than assumed."""
+    import jax
+
+    j = jax.jit(fn)
+    for args in calls:
+        jax.block_until_ready(j(*args))
+    size = j._cache_size()
+    if size != 1:
+        raise AssertionError(
+            f"expected one compiled program, jit cache holds {size} — "
+            f"an argument is changing shape/dtype/structure across calls")
+    return size
+
+
+# ----------------------------------------------------- zero-cost check
+
+
+def _run_of(obj: Callable | Any) -> Callable:
+    return obj.run if hasattr(obj, "run") else obj
+
+
+def assert_zero_cost(base, off, on, stop, *, get_subtree=None) -> dict:
+    """The centralized trace/spill/faults zero-cost check.
+
+    `base`/`off`/`on` are (engine_or_run, state) pairs: `base` built
+    with defaults, `off` with the subsystem explicitly disabled, `on`
+    with it enabled. Asserts the off build is indistinguishable from
+    the base build — same leaf count, same pytree structure, same
+    checkpoint leaf paths, byte-identical lowered HLO — and that the
+    on build actually lowers differently (so the check cannot pass
+    vacuously). `get_subtree(state)` optionally points at the
+    subsystem's state slot, asserted None when off / present when on.
+
+    Returns {"base": text, "off": text, "on": text} for extra checks.
+    """
+    import jax
+
+    from shadow_tpu.utils.checkpoint import _leaf_paths
+
+    (eng_b, st_b), (eng_off, st_off), (eng_on, st_on) = base, off, on
+
+    n_b = len(jax.tree.leaves(st_b))
+    n_off = len(jax.tree.leaves(st_off))
+    assert n_off == n_b, \
+        f"off state has {n_off} leaves vs base {n_b} — the disabled " \
+        f"subsystem still contributes pytree leaves"
+    assert jax.tree.structure(st_off) == jax.tree.structure(st_b), \
+        "off/base pytree structures differ"
+    assert _leaf_paths(st_off) == _leaf_paths(st_b), \
+        "off/base checkpoint leaf layouts differ"
+
+    if get_subtree is not None:
+        # state-carrying subsystems (trace ring, spill ring): the on
+        # build must hold the subtree and grow the leaf set. Engine-
+        # constant subsystems (faults) change only the program — pass
+        # get_subtree=None for those.
+        assert get_subtree(st_b) is None, \
+            "base state carries the optional subsystem's subtree"
+        assert get_subtree(st_off) is None, \
+            "off state carries the optional subsystem's subtree"
+        assert get_subtree(st_on) is not None, \
+            "on state is missing the subsystem's subtree (check knobs)"
+        assert len(jax.tree.leaves(st_on)) > n_b, \
+            "on state added no leaves — the subsystem is not actually on"
+
+    text_b = lower_text(_run_of(eng_b), st_b, stop)
+    text_off = lower_text(_run_of(eng_off), st_off, stop)
+    text_on = lower_text(_run_of(eng_on), st_on, stop)
+    assert text_off == text_b, \
+        "disabled subsystem changed the lowered program (zero-cost " \
+        "violation — diff the returned texts)"
+    assert text_on != text_b, \
+        "enabled subsystem lowered identically to base — the zero-cost " \
+        "check is vacuous"
+    return {"base": text_b, "off": text_off, "on": text_on}

@@ -1,0 +1,664 @@
+"""shadowlint — AST linter for JAX footguns in the shadow_tpu package.
+
+The simulator's correctness story leans on a small set of disciplines
+(ROADMAP.md invariants; docs/10-Static-Analysis.md rule catalog):
+everything in the window loop traces once and lowers to one XLA
+program, simulated time is always the `core.timebase` dtype (i64 ns),
+and pytrees have a deterministic leaf order. Each lint rule guards one
+way those disciplines have been (or nearly were) broken:
+
+- SL101 host materialization in jit scope — ``float()``/``int()``/
+  ``bool()`` on traced values, ``.item()``, ``np.*`` compute,
+  ``jax.device_get``: silently forces a device sync per call, or a
+  tracer error at the worst possible time.
+- SL102 Python branch on a traced value in jit scope — ``if``/``while``
+  on a tracer raises ConcretizationTypeError only for the config that
+  first reaches the branch.
+- SL103 i32 arithmetic/casts on simulated-time expressions — i32
+  nanoseconds wrap after ~2.1 s of simulated time; the PR 4 ``drops``
+  widening was exactly this bug one field over.
+- SL104 PRNG key reuse without ``split`` — two draws from one key are
+  perfectly correlated; invisible in smoke tests, fatal to statistics.
+- SL105 mutable default (function defaults and class-body defaults) —
+  shared-instance aliasing, and a stale-pytree hazard for dataclass
+  state.
+- SL106 iteration over a ``set`` when building pytrees/collections —
+  set order is hash order; pytree leaf order must be deterministic
+  across processes (checkpoint layout, multi-host bit-identity).
+
+Findings carry a stable key (rule | relpath | enclosing function |
+stripped source line) so the baseline survives unrelated line drift.
+Inline suppression: ``# shadowlint: disable=SL101,SL104`` (or a bare
+``# shadowlint: disable``) on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+RULES = {
+    "SL101": "host materialization inside jit scope",
+    "SL102": "Python branch on a traced value inside jit scope",
+    "SL103": "i32 cast/construction of a simulated-time expression",
+    "SL104": "PRNG key reuse without split",
+    "SL105": "mutable default argument or class-body default",
+    "SL106": "iteration over a set (nondeterministic order)",
+}
+
+# Functions whose callee-arguments are traced (their bodies are jit
+# scope): jax.jit itself plus the structured control-flow / mapping
+# combinators the engine uses.
+_JIT_WRAPPERS = {
+    "jit",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "scan",
+    "switch",
+    "vmap",
+    "pmap",
+    "shard_map",
+    "checkpoint",
+    "remat",
+    "custom_jvp",
+    "custom_vjp",
+}
+
+# np.<attr> uses that are dtype/constant plumbing, not host compute.
+_NP_ALLOWED = {
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64",
+    "bool_", "dtype", "ndarray", "generic",
+    "pi", "inf", "nan", "newaxis",
+    "iinfo", "finfo", "issubdtype", "integer", "floating",
+}
+
+# Time-like identifier fragments (core/timebase.py semantics: these
+# carry simulated nanoseconds and must stay TIME_DTYPE = i64)...
+_TIMEY = re.compile(
+    r"(?:^|_|\b)(time|now|deadline|delay|due|latency|clock|window_end|"
+    r"stoptime|cpu_free|t0|t1|ns|when|expiry|timeout)(?:_|\b|$)",
+    re.IGNORECASE,
+)
+# ...unless the name is really a count/index that happens to mention
+# time (event counts, sequence numbers, shard ranks, ...).
+_NOT_TIMEY = re.compile(
+    r"(count|idx|index|seq|rank|slot|drops|num_|n_|_id\b|mask|kind|bins)",
+    re.IGNORECASE,
+)
+
+_PRNG_CONSUMERS_SKIP = {
+    "split", "fold_in", "PRNGKey", "key", "key_data", "wrap_key_data",
+    "clone",
+}
+_PRNG_NAMESPACES = {"srng", "random", "jr", "rng"}
+
+_SUPPRESS_RE = re.compile(r"#\s*shadowlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative where possible
+    line: int
+    col: int
+    func: str  # dotted enclosing-scope name ("<module>" at top level)
+    message: str
+    snippet: str  # stripped source line (stable-key component)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.func}|{self.snippet}"
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.func}] {self.message}")
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ast.dump(node)
+
+
+def _call_basename(func: ast.AST) -> str:
+    """Rightmost name of a call target: jax.lax.while_loop -> while_loop."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _attr_root(node: ast.AST) -> str:
+    """Leftmost name of an attribute chain: self.cfg.trace -> self."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _is_timey(text: str) -> bool:
+    return bool(_TIMEY.search(text)) and not _NOT_TIMEY.search(text)
+
+
+def _is_int32_expr(node: ast.AST) -> bool:
+    """jnp.int32 / np.int32 / 'int32' / "i4"-style dtype expressions."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in ("int32", "i32", "<i4", "i4")
+    if isinstance(node, ast.Attribute) and node.attr == "int32":
+        return _attr_root(node) in ("jnp", "np", "numpy", "jax")
+    return False
+
+
+class _Scope:
+    """Per-function lint context threaded through the visitor."""
+
+    def __init__(self, name: str, jitted: bool, params: set[str]):
+        self.name = name
+        self.jitted = jitted
+        self.params = params  # traced-candidate parameter names
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.lines = src.splitlines()
+        self.findings: list[Finding] = []
+        self.scopes: list[_Scope] = [_Scope("<module>", False, set())]
+        # names referenced as callee arguments of jit wrappers anywhere
+        # in the file (pass 1) — their defs are jit scope
+        self.jit_marked: set[str] = set()
+        # per-function PRNG use tracking: {keyname: [linenos]}
+        self._prng_uses: list[dict[str, list[ast.Call]]] = [{}]
+
+    # ------------------------------------------------------------ utils
+
+    def _suppressed(self, line: int, rule: str) -> bool:
+        if 1 <= line <= len(self.lines):
+            m = _SUPPRESS_RE.search(self.lines[line - 1])
+            if m:
+                if not m.group(1):
+                    return True
+                rules = {r.strip() for r in m.group(1).split(",")}
+                return rule in rules
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._suppressed(line, rule):
+            return
+        snippet = ""
+        if 1 <= line <= len(self.lines):
+            snippet = self.lines[line - 1].strip()
+        func = ".".join(s.name for s in self.scopes[1:]) or "<module>"
+        self.findings.append(
+            Finding(rule, self.path, line, getattr(node, "col_offset", 0),
+                    func, message, snippet))
+
+    @property
+    def _scope(self) -> _Scope:
+        return self.scopes[-1]
+
+    def _in_jit(self) -> bool:
+        return any(s.jitted for s in self.scopes)
+
+    def _traced_names(self) -> set[str]:
+        names: set[str] = set()
+        for s in self.scopes:
+            if s.jitted:
+                names |= s.params
+        return names
+
+    # --------------------------------------------------------- functions
+
+    def _func_is_jitted(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+        for dec in node.decorator_list:
+            base = dec
+            if isinstance(base, ast.Call):  # @partial(jax.jit, ...)
+                if any(_call_basename(a) in _JIT_WRAPPERS
+                       for a in base.args
+                       if isinstance(a, (ast.Name, ast.Attribute))):
+                    return True
+                base = base.func
+            if _call_basename(base) in _JIT_WRAPPERS:
+                return True
+        if node.name in self.jit_marked:
+            return True
+        return self._in_jit()  # nested defs inherit jit scope
+
+    def _visit_funcdef(self, node) -> None:
+        jitted = self._func_is_jitted(node)
+        params = set()
+        if jitted:
+            a = node.args
+            names = [p.arg for p in
+                     (a.posonlyargs + a.args + a.kwonlyargs)]
+            # drop self/cls and obviously-static plumbing names; params
+            # with defaults are usually static feature flags
+            n_def = len(a.defaults)
+            defaulted = {p.arg for p in a.args[len(a.args) - n_def:]} if n_def else set()
+            defaulted |= {p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults) if d}
+            for n in names:
+                if n in ("self", "cls", "cfg", "config", "axis_name",
+                         "dtype", "shape", "name"):
+                    continue
+                if n in defaulted:
+                    continue
+                params.add(n)
+        # SL105: mutable defaults
+        for d in list(node.args.defaults) + [d for d in node.args.kw_defaults if d]:
+            if self._mutable_literal(d):
+                self._emit("SL105", d,
+                           f"mutable default `{_unparse(d)}` in "
+                           f"{node.name}() is shared across calls; use "
+                           f"None + in-body construction (or a tuple)")
+        self.scopes.append(_Scope(node.name, jitted, params))
+        self._prng_uses.append({})
+        self.generic_visit(node)
+        self._flush_prng()
+        self._prng_uses.pop()
+        self.scopes.pop()
+
+    visit_FunctionDef = _visit_funcdef
+    visit_AsyncFunctionDef = _visit_funcdef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        # SL105 for class-body defaults (dataclass fields included):
+        # a mutable class attribute is shared by every instance/pytree.
+        for stmt in node.body:
+            val = None
+            if isinstance(stmt, ast.AnnAssign):
+                val = stmt.value
+                tgts = [stmt.target]
+            elif isinstance(stmt, ast.Assign):
+                val = stmt.value
+                tgts = stmt.targets
+            if val is not None and any(
+                    isinstance(t, ast.Name) and t.id in
+                    ("_fields_", "_anonymous_", "__slots__",
+                     "__match_args__")
+                    for t in tgts):
+                # ctypes/structure protocol attributes: consumed by the
+                # metaclass at class creation, never mutated
+                val = None
+            if val is not None and self._mutable_literal(val):
+                self._emit("SL105", val,
+                           f"mutable class-body default `{_unparse(val)}` "
+                           f"in {node.name} is shared by every instance; "
+                           f"use dataclasses.field(default_factory=...)")
+        self.scopes.append(_Scope(node.name, False, set()))
+        self._prng_uses.append({})
+        self.generic_visit(node)
+        self._prng_uses.pop()
+        self.scopes.pop()
+
+    @staticmethod
+    def _mutable_literal(node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            return node.func.id in ("list", "dict", "set") and not node.args \
+                and not node.keywords
+        return False
+
+    # ------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        base = _call_basename(node.func)
+
+        # pass-1 marking is done before visiting; nothing to do here for
+        # wrapper detection.
+
+        in_jit = self._in_jit()
+        traced = self._traced_names() if in_jit else set()
+
+        # SL101: float()/int()/bool() on traced-looking args in jit scope
+        if in_jit and isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") and node.args:
+            if self._mentions(node.args[0], traced):
+                self._emit("SL101", node,
+                           f"`{node.func.id}()` on a traced value forces "
+                           f"host materialization inside jit scope")
+
+        # SL101: .item() / jax.device_get / np.* compute in jit scope
+        if in_jit and isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("item", "tolist", "block_until_ready"):
+                self._emit("SL101", node,
+                           f"`.{node.func.attr}()` materializes on host "
+                           f"inside jit scope")
+            elif node.func.attr == "device_get" \
+                    and _attr_root(node.func) == "jax":
+                self._emit("SL101", node,
+                           "`jax.device_get` inside jit scope")
+            elif _attr_root(node.func) in ("np", "numpy") \
+                    and node.func.attr not in _NP_ALLOWED:
+                self._emit("SL101", node,
+                           f"`np.{node.func.attr}(...)` runs on host "
+                           f"inside jit scope; use jnp")
+
+        # SL103: i32 construction of a time-like expression
+        self._check_i32_time(node)
+
+        # SL104: collect PRNG consumer uses
+        self._track_prng(node)
+
+        self.generic_visit(node)
+
+    def _mentions(self, node: ast.AST, names: set[str]) -> bool:
+        if not names:
+            return False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in names:
+                return True
+        return False
+
+    # ------------------------------------------------------ SL102 branch
+
+    def _check_branch(self, node, kind: str) -> None:
+        if not self._in_jit():
+            self.generic_visit(node)
+            return
+        test = node.test
+        if self._test_whitelisted(test):
+            self.generic_visit(node)
+            return
+        traced = self._traced_names()
+        if self._mentions(test, traced):
+            self._emit("SL102", node,
+                       f"Python `{kind}` on `{_unparse(test)}` — traced "
+                       f"values cannot drive Python control flow; use "
+                       f"lax.cond/jnp.where")
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node, "ternary")
+
+    @staticmethod
+    def _test_whitelisted(test: ast.AST) -> bool:
+        """Static-dispatch shapes: isinstance/hasattr/len checks, `is
+        (not) None`, and attribute chains rooted at self/cfg (static
+        engine configuration, not traced state)."""
+        def ok(node: ast.AST) -> bool:
+            if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+                return ok(node.operand)
+            if isinstance(node, ast.BoolOp):
+                return all(ok(v) for v in node.values)
+            if isinstance(node, ast.Call):
+                return _call_basename(node.func) in (
+                    "isinstance", "hasattr", "len", "callable", "getattr")
+            if isinstance(node, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in node.ops):
+                    return True
+                return ok(node.left) and all(ok(c) for c in node.comparators)
+            if isinstance(node, ast.Attribute):
+                return _attr_root(node) in ("self", "cfg", "config")
+            if isinstance(node, ast.Constant):
+                return True
+            return False
+        return ok(test)
+
+    # -------------------------------------------------------- SL103 time
+
+    def _check_i32_time(self, node: ast.Call) -> None:
+        base = _call_basename(node.func)
+        # <timey>.astype(int32-ish)
+        if base == "astype" and node.args and _is_int32_expr(node.args[0]) \
+                and isinstance(node.func, ast.Attribute):
+            target = _unparse(node.func.value)
+            if _is_timey(target):
+                self._emit("SL103", node,
+                           f"`{target}.astype(int32)` truncates simulated "
+                           f"time (wraps after ~2.1 s); keep "
+                           f"timebase.TIME_DTYPE")
+            return
+        # jnp.int32(<timey>) / np.int32(<timey>)
+        if base == "int32" and node.args \
+                and _attr_root(node.func) in ("jnp", "np", "numpy"):
+            arg = _unparse(node.args[0])
+            if _is_timey(arg):
+                self._emit("SL103", node,
+                           f"`int32({arg})` truncates simulated time; "
+                           f"keep timebase.TIME_DTYPE")
+            return
+        # dtype=int32 kwarg where a positional arg is time-like.
+        # Comparisons are exempt: `sum(t != TIME_INVALID, dtype=int32)`
+        # counts booleans derived FROM time — count arithmetic, not
+        # time arithmetic.
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _is_int32_expr(kw.value):
+                args = [a for a in node.args
+                        if not isinstance(a, ast.Compare)]
+                texts = [_unparse(a) for a in args]
+                if any(_is_timey(t) for t in texts):
+                    self._emit("SL103", node,
+                               f"`dtype=int32` on time-like value "
+                               f"`{', '.join(texts)}`; keep "
+                               f"timebase.TIME_DTYPE")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # SL103: timey_name = jnp.zeros(..., dtype=int32)-style constructions
+        if isinstance(node.value, ast.Call):
+            for kw in node.value.keywords:
+                if kw.arg == "dtype" and _is_int32_expr(kw.value):
+                    for tgt in node.targets:
+                        t = _unparse(tgt)
+                        if _is_timey(t) and not self._suppressed(
+                                node.lineno, "SL103"):
+                            self._emit("SL103", node,
+                                       f"time-like `{t}` constructed with "
+                                       f"dtype=int32; keep "
+                                       f"timebase.TIME_DTYPE")
+                        break
+        # SL104: reassignment of a key name resets its use count
+        for tgt in node.targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    self._prng_uses[-1].pop(sub.id, None)
+        self.generic_visit(node)
+
+    # -------------------------------------------------------- SL104 PRNG
+
+    def _track_prng(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        root = _attr_root(node.func)
+        chain_is_jax_random = (
+            isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "random"
+            and _attr_root(node.func.value) == "jax")
+        if root not in _PRNG_NAMESPACES and not chain_is_jax_random:
+            return
+        if "stream" in node.func.attr:
+            # counter-based stream APIs (core/rng.py fault_stream_*,
+            # uniform_lanes-style) take (seed, stream_id): the first
+            # arg is deliberately reused across distinct stream ids
+            return
+        if node.func.attr in _PRNG_CONSUMERS_SKIP:
+            # split/fold_in consume-and-derive; also reset the budget
+            # for their source key (splitting IS the fix for reuse)
+            if node.args and isinstance(node.args[0], ast.Name):
+                self._prng_uses[-1].pop(node.args[0].id, None)
+            return
+        if node.args and isinstance(node.args[0], ast.Name):
+            self._prng_uses[-1].setdefault(node.args[0].id, []).append(node)
+
+    def _flush_prng(self) -> None:
+        for name, calls in self._prng_uses[-1].items():
+            if len(calls) >= 2:
+                for call in calls[1:]:
+                    self._emit(
+                        "SL104", call,
+                        f"PRNG key `{name}` already consumed at line "
+                        f"{calls[0].lineno}; reuse correlates draws — "
+                        f"split first")
+
+    # --------------------------------------------------------- SL106 set
+
+    def _check_set_iter(self, iter_node: ast.AST, where: ast.AST) -> None:
+        is_set = isinstance(iter_node, (ast.Set, ast.SetComp)) or (
+            isinstance(iter_node, ast.Call)
+            and isinstance(iter_node.func, ast.Name)
+            and iter_node.func.id in ("set", "frozenset"))
+        if is_set:
+            self._emit("SL106", where,
+                       f"iterating `{_unparse(iter_node)}` — set order is "
+                       f"hash order; sort first (pytree leaf order must "
+                       f"be deterministic)")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_set_iter(node.iter, node)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        for gen in node.generators:
+            self._check_set_iter(gen.iter, node)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+
+class _JitMarker(ast.NodeVisitor):
+    """Pass 1: collect names referenced as callee arguments of jit
+    wrappers (lax.while_loop(cond, body, ...) marks cond/body)."""
+
+    def __init__(self) -> None:
+        self.marked: set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _call_basename(node.func) in _JIT_WRAPPERS:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    self.marked.add(a.id)
+                elif isinstance(a, (ast.List, ast.Tuple)):
+                    for el in a.elts:
+                        if isinstance(el, ast.Name):
+                            self.marked.add(el.id)
+                elif isinstance(a, ast.Attribute):
+                    # lax.while_loop(cond, self._body, ...) marks _body
+                    self.marked.add(a.attr)
+        self.generic_visit(node)
+
+
+# ------------------------------------------------------------- frontend
+
+
+def _rel(path: str) -> str:
+    root = _repo_root()
+    try:
+        return os.path.relpath(os.path.abspath(path), root)
+    except ValueError:
+        return path
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def lint_source(src: str, path: str = "<string>") -> list[Finding]:
+    """Lint one source text. `path` labels findings (and baseline keys)."""
+    tree = ast.parse(src, filename=path)
+    marker = _JitMarker()
+    marker.visit(tree)
+    linter = _Linter(path, src)
+    linter.jit_marked = marker.marked
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(lint_source(src, _rel(p)))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def package_files(root: str | None = None) -> list[str]:
+    """All .py files of the shadow_tpu package (analysis included —
+    the linter lints itself)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_package(root: str | None = None) -> list[Finding]:
+    return lint_paths(package_files(root))
+
+
+# ------------------------------------------------------------- baseline
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "lint_baseline.json")
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def save_baseline(findings: Iterable[Finding],
+                  path: str = BASELINE_PATH) -> dict[str, int]:
+    entries: dict[str, int] = {}
+    for f in findings:
+        entries[f.key] = entries.get(f.key, 0) + 1
+    data = {
+        "version": 1,
+        "comment": "shadowlint accepted findings; regenerate with "
+                   "`python -m shadow_tpu.tools.lint --update-baseline`",
+        "entries": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return entries
+
+
+def split_new(findings: Iterable[Finding],
+              baseline: dict[str, int]) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Partition findings into (new, baselined) and report stale
+    baseline keys that matched nothing (candidates for pruning)."""
+    budget = dict(baseline)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, v in budget.items() if v > 0)
+    return new, old, stale

@@ -76,7 +76,11 @@ def _make_draw(n_hosts_global, mean_delay_ns, hot_hosts, hot_weight):
         peer = srng.randint(kp, 0, n_hosts_global)
         if hot_hosts > 0 and hot_weight > 0.0:
             hot = srng.uniform(kh) < hot_weight
-            peer_hot = srng.randint(kp, 0, hot_hosts)
+            # folded sub-key: reusing kp here would correlate the hot
+            # draw with the uniform one (peer_hot == peer % hot_hosts
+            # whenever bounds divide); non-hot draws keep their keys so
+            # plain-PHOLD trajectories are unchanged
+            peer_hot = srng.randint(srng.fold_in(kp, 1), 0, hot_hosts)
             peer = jnp.where(hot, peer_hot, peer)
         delay = (
             srng.exponential(kd) * mean_delay_ns

@@ -46,8 +46,24 @@ class ShimComp(ctypes.Structure):
     ]
 
 
+# ASan + UBSan, leak checking on, hard-fail on any UB report. These can
+# only be applied to EXECUTABLE targets here: this container's dynamic
+# loader cannot host a sanitized DSO in a dlmopen namespace (the ASan
+# runtime must be first in the *initial* library list, and a secondary
+# namespace has no such slot — every preload/static-libasan variant
+# fails link-time or load-time). sanitizer_smoke() therefore links the
+# interposer INTO a sanitized driver binary instead of sanitizing the
+# plugin .so path.
+SANITIZE_FLAGS = [
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=all",
+    "-fno-omit-frame-pointer",
+    "-g", "-O1",
+]
+
+
 def _compile(sources: list[str], out: str, extra: list[str],
-             cc: str | None = None) -> str:
+             cc: str | None = None, sanitize: bool = False) -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if os.path.exists(out) and all(
         os.path.getmtime(out) >= os.path.getmtime(s) for s in sources
@@ -55,7 +71,8 @@ def _compile(sources: list[str], out: str, extra: list[str],
         return out
     if cc is None:
         cc = "gcc" if all(s.endswith(".c") for s in sources) else "g++"
-    cmd = [cc, "-O2", "-fPIC", "-shared", "-o", out, *sources,
+    opt = SANITIZE_FLAGS if sanitize else ["-O2"]
+    cmd = [cc, *opt, "-fPIC", "-shared", "-o", out, *sources,
            "-I", _SHIM_DIR, "-ldl", *extra]
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
@@ -84,6 +101,50 @@ def build_interposer() -> str:
         os.path.join(_BUILD_DIR, "libshadow_interpose.so"),
         [],
     )
+
+
+def build_sanitizer_smoke() -> str:
+    """Compile (if stale) interpose.c + asan_smoke.c into ONE sanitized
+    executable. Statically linking the interposer into the driver makes
+    its libc-shadowing definitions bind for the driver's direct calls —
+    the same resolution order a dlmopen namespace gives plugins — while
+    keeping the sanitizer runtime first in the initial library list,
+    which the dlmopen path cannot (see SANITIZE_FLAGS note)."""
+    sources = [
+        os.path.join(_INTERPOSE_DIR, "interpose.c"),
+        os.path.join(_INTERPOSE_DIR, "asan_smoke.c"),
+    ]
+    out = os.path.join(_BUILD_DIR, "asan_smoke")
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in sources
+    ):
+        return out
+    cmd = ["gcc", *SANITIZE_FLAGS, "-D_GNU_SOURCE", "-o", out, *sources,
+           "-I", _SHIM_DIR, "-ldl"]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"sanitizer smoke build failed:\n{' '.join(cmd)}\n{res.stderr}")
+    return out
+
+
+def sanitizer_smoke(timeout: float = 120.0) -> dict:
+    """Build and run the sanitized interposer harness.
+
+    Returns {"ok", "returncode", "stdout", "stderr", "exe"}; ok requires
+    exit 0 AND the ASAN_SMOKE_OK stamp (a sanitizer abort yields
+    neither). Leak checking is forced on so the vfd/epoll/sigtable
+    reset paths are verified to free what they allocate."""
+    exe = build_sanitizer_smoke()
+    env = dict(os.environ)
+    env["ASAN_OPTIONS"] = "detect_leaks=1:abort_on_error=0:exitcode=23"
+    env["UBSAN_OPTIONS"] = "halt_on_error=1:print_stacktrace=1"
+    res = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=timeout, env=env)
+    ok = res.returncode == 0 and "ASAN_SMOKE_OK" in res.stdout
+    return {"ok": ok, "returncode": res.returncode,
+            "stdout": res.stdout, "stderr": res.stderr, "exe": exe}
 
 
 def compile_posix_plugin(

@@ -1,0 +1,104 @@
+"""shadowlint CLI — lint gate + HLO contract audit with JSON output.
+
+    python -m shadow_tpu.tools.lint                 # lint the package
+    python -m shadow_tpu.tools.lint path/to/file.py # lint specific files
+    python -m shadow_tpu.tools.lint --update-baseline
+    python -m shadow_tpu.tools.lint --hlo-audit all # + lowering audit
+    python -m shadow_tpu.tools.lint --hlo-audit phold,tgen
+
+Exit status: 0 when there are no findings outside the checked-in
+baseline (and, with --hlo-audit, every audited config meets its
+contract); 1 otherwise. Output is a single JSON document on stdout —
+machine-readable for the measure_all.sh lint stage — with human
+one-liners on stderr.
+
+The baseline (shadow_tpu/analysis/lint_baseline.json) holds accepted
+findings keyed by (rule | path | function | source line) so they
+survive line drift; stale entries are reported (not fatal) so the
+baseline shrinks as findings are fixed. See docs/10-Static-Analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from shadow_tpu.analysis import lint as L
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m shadow_tpu.tools.lint",
+        description="AST lint + HLO contract audit for shadow_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: the shadow_tpu package)")
+    ap.add_argument("--baseline", default=L.BASELINE_PATH,
+                    help="baseline JSON path (default: packaged baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding as new")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept current findings into the baseline and "
+                         "exit 0")
+    ap.add_argument("--hlo-audit", metavar="CONFIGS", default=None,
+                    help="also lower + audit model configs: 'all' or a "
+                         "comma list of phold,phold_net,tgen,tor,bitcoin")
+    ap.add_argument("--output", default=None,
+                    help="write the JSON report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    findings = L.lint_paths(args.paths) if args.paths else L.lint_package()
+
+    if args.update_baseline:
+        entries = L.save_baseline(findings, args.baseline)
+        print(f"baseline: {len(entries)} keys "
+              f"({len(findings)} findings) -> {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    baseline = {} if args.no_baseline else L.load_baseline(args.baseline)
+    new, old, stale = L.split_new(findings, baseline)
+
+    report = {
+        "findings": [f.to_json() for f in new],
+        "baselined": len(old),
+        "new": len(new),
+        "stale_baseline_keys": stale,
+        "rules": L.RULES,
+    }
+    failed = bool(new)
+
+    if args.hlo_audit:
+        # imported lazily: the pure lint path must not pull in jax
+        from shadow_tpu.analysis import hlo_audit as H
+
+        names = (sorted(H.CONTRACTS) if args.hlo_audit == "all"
+                 else [n.strip() for n in args.hlo_audit.split(",") if
+                       n.strip()])
+        audit = H.audit_all(names)
+        report["hlo_audit"] = audit
+        for name, res in audit.items():
+            if not res["ok"]:
+                failed = True
+                for v in res["violations"]:
+                    print(f"hlo_audit: {v}", file=sys.stderr)
+
+    for f in new:
+        print(str(f), file=sys.stderr)
+    if stale:
+        print(f"note: {len(stale)} stale baseline keys (safe to "
+              f"--update-baseline)", file=sys.stderr)
+    print(f"shadowlint: {len(new)} new, {len(old)} baselined",
+          file=sys.stderr)
+
+    text = json.dumps(report, indent=1)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    else:
+        print(text)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

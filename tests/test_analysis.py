@@ -1,0 +1,398 @@
+"""Static-analysis layer (shadow_tpu/analysis/): lint rules, baseline
+workflow, and the HLO contract auditor.
+
+Each lint rule gets a fixture snippet that must trip it and a nearby
+idiom that must NOT (the exemptions are as load-bearing as the rules:
+bool-compare counts, counter-based stream RNG, ctypes protocol
+attributes). The auditor is exercised against the real phold engine —
+clean by contract — and against an injected forbidden-op variant it
+must reject. The five-config audit runs in the slow lane (and in the
+measure_all.sh lint stage); docs/10-Static-Analysis.md is the catalog.
+"""
+
+import dataclasses
+import json
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shadow_tpu.analysis import hlo_audit as H
+from shadow_tpu.analysis import lint as L
+from shadow_tpu.core.timebase import MILLISECOND
+from shadow_tpu.models import phold
+
+
+def _lint(src: str, path: str = "<fixture>"):
+    return L.lint_source(textwrap.dedent(src), path)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- lint rules
+
+
+def test_sl101_host_materialization_in_jit():
+    fs = _lint("""
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            y = float(x)
+            z = np.sin(x)
+            w = x.item()
+            return y + z + w
+    """)
+    assert _rules(fs) == ["SL101"] and len(fs) == 3
+
+
+def test_sl101_silent_outside_jit():
+    fs = _lint("""
+        import numpy as np
+        def host_side(arr):
+            return float(np.sin(arr).sum())
+    """)
+    assert fs == []
+
+
+def test_sl102_tracer_branch_in_jit():
+    fs = _lint("""
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            while x < 3:
+                x = x + 1
+            return x
+    """)
+    assert _rules(fs) == ["SL102"] and len(fs) == 2
+
+
+def test_sl102_static_tests_whitelisted():
+    # isinstance / `is None` / self.cfg-rooted flags are static dispatch
+    fs = _lint("""
+        import jax
+        @jax.jit
+        def f(self, x, flag=None):
+            if x is None:
+                return 0
+            if isinstance(x, tuple):
+                return 1
+            if self.cfg.trace:
+                return 2
+            return x
+    """)
+    assert fs == []
+
+
+def test_sl102_marks_while_loop_bodies():
+    # jit scope via lax.while_loop reference, not a decorator
+    fs = _lint("""
+        import jax
+        from jax import lax
+        def outer(st0):
+            def body(st):
+                if st > 0:
+                    st = st - 1
+                return st
+            def cond(st):
+                return st > 0
+            return lax.while_loop(cond, body, st0)
+    """)
+    assert "SL102" in _rules(fs)
+
+
+def test_sl103_i32_time_cast():
+    fs = _lint("""
+        import jax.numpy as jnp
+        def g(due_time):
+            a = due_time.astype(jnp.int32)
+            b = jnp.int32(due_time)
+            delay_ns = jnp.zeros(4, dtype=jnp.int32)
+            return a, b, delay_ns
+    """)
+    assert _rules(fs) == ["SL103"] and len(fs) == 3
+
+
+def test_sl103_bool_compare_counts_exempt():
+    # `sum(t != INVALID, dtype=int32)` counts booleans derived from
+    # time — count arithmetic, not time truncation (engine idiom)
+    fs = _lint("""
+        import jax.numpy as jnp
+        def g(stage_time, TIME_INVALID):
+            n = jnp.sum(stage_time != TIME_INVALID, axis=1,
+                        dtype=jnp.int32)
+            return n
+    """)
+    assert fs == []
+
+
+def test_sl104_prng_key_reuse():
+    fs = _lint("""
+        from shadow_tpu.core import rng as srng
+        def h(key):
+            a = srng.uniform(key)
+            b = srng.randint(key, 0, 4)
+            return a, b
+    """)
+    assert _rules(fs) == ["SL104"]
+
+
+def test_sl104_split_and_streams_exempt():
+    fs = _lint("""
+        from shadow_tpu.core import rng as srng
+        def h(key, seed):
+            k1, k2 = srng.split(key, 2)
+            a = srng.uniform(k1)
+            b = srng.randint(k2, 0, 4)
+            u = srng.fault_stream_uniform(seed, 1, 8)
+            v = srng.fault_stream_uniform(seed, 2, 8)
+            return a, b, u, v
+    """)
+    assert fs == []
+
+
+def test_sl104_reassignment_resets():
+    fs = _lint("""
+        from shadow_tpu.core import rng as srng
+        def h(key):
+            a = srng.uniform(key)
+            key = srng.fold_in(key, 1)
+            b = srng.uniform(key)
+            return a, b
+    """)
+    assert fs == []
+
+
+def test_sl105_mutable_defaults():
+    fs = _lint("""
+        def f(x, acc=[]):
+            acc.append(x)
+            return acc
+        class C:
+            registry = {}
+    """)
+    assert _rules(fs) == ["SL105"] and len(fs) == 2
+
+
+def test_sl105_ctypes_fields_exempt():
+    fs = _lint("""
+        import ctypes
+        class Req(ctypes.Structure):
+            _fields_ = [("pid", ctypes.c_int32)]
+    """)
+    assert fs == []
+
+
+def test_sl106_set_iteration():
+    fs = _lint("""
+        import jax
+        @jax.jit
+        def f(x):
+            out = [x[i] for i in {2, 1, 0}]
+            for k in set((1, 2)):
+                out.append(k)
+            return out
+    """)
+    assert _rules(fs) == ["SL106"] and len(fs) == 2
+
+
+def test_inline_suppression():
+    fs = _lint("""
+        from shadow_tpu.core import rng as srng
+        def h(key):
+            a = srng.uniform(key)
+            b = srng.randint(key, 0, 4)  # shadowlint: disable=SL104
+            return a, b
+    """)
+    assert fs == []
+
+
+def test_suppression_is_rule_scoped():
+    # disabling SL101 does not silence an SL104 on the same line
+    fs = _lint("""
+        from shadow_tpu.core import rng as srng
+        def h(key):
+            a = srng.uniform(key)
+            b = srng.randint(key, 0, 4)  # shadowlint: disable=SL101
+            return a, b
+    """)
+    assert _rules(fs) == ["SL104"]
+
+
+# ------------------------------------------------------ baseline workflow
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = textwrap.dedent("""
+        from shadow_tpu.core import rng as srng
+        def h(key):
+            a = srng.uniform(key)
+            b = srng.randint(key, 0, 4)
+            return a, b
+    """)
+    findings = L.lint_source(src, "fixture.py")
+    assert findings
+
+    path = str(tmp_path / "baseline.json")
+    L.save_baseline(findings, path)
+    baseline = L.load_baseline(path)
+
+    # accepted findings don't block...
+    new, old, stale = L.split_new(findings, baseline)
+    assert new == [] and len(old) == len(findings) and stale == []
+
+    # ...a new finding does...
+    worse = src + "    c = srng.uniform(key)\n"
+    new2, _, _ = L.split_new(L.lint_source(worse, "fixture.py"), baseline)
+    assert len(new2) >= 1
+
+    # ...and keys survive pure line drift (comment above the finding)
+    drifted = src.replace("def h(key):", "# a comment\ndef h(key):")
+    new3, old3, _ = L.split_new(L.lint_source(drifted, "fixture.py"),
+                                baseline)
+    assert new3 == [] and len(old3) == len(findings)
+
+    # fixed findings surface as stale keys, not errors
+    _, _, stale4 = L.split_new([], baseline)
+    assert len(stale4) == len(baseline)
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: zero findings outside the checked-in
+    baseline across the whole package."""
+    new, _, _ = L.split_new(L.lint_package(), L.load_baseline())
+    assert new == [], "\n".join(str(f) for f in new)
+
+
+# ------------------------------------------------------------- hlo audit
+
+
+def test_audit_text_budgets_and_callbacks():
+    contract = H.HloContract("t", {"scatter": 1, "custom_call": 0})
+    clean = 'stablehlo.sort ...\nstablehlo.scatter ...\n'
+    assert H.audit_text(clean, contract) == []
+    over = clean + 'stablehlo.scatter ...\n'
+    assert any("scatter" in v for v in H.audit_text(over, contract))
+    cb = clean + 'stablehlo.outfeed ...\n'
+    assert any("outfeed" in v for v in H.audit_text(cb, contract))
+
+
+def test_audit_text_custom_call_allowlist():
+    contract = H.HloContract("t", {"scatter": 0, "custom_call": 2},
+                             custom_call_allow=("Sharding",))
+    ok = 'stablehlo.custom_call @x {call_target_name = "Sharding"}\n'
+    assert H.audit_text(ok, contract) == []
+    bad = 'stablehlo.custom_call @x {call_target_name = "MyOp"}\n'
+    assert any("MyOp" in v for v in H.audit_text(bad, contract))
+    pycb = ('stablehlo.custom_call @x '
+            '{call_target_name = "xla_python_cpu_callback"}\n')
+    assert any("host-callback" in v for v in H.audit_text(pycb, contract))
+
+
+@pytest.fixture(scope="module")
+def phold_build():
+    eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+    return eng, init()
+
+
+def test_phold_engine_meets_contract(phold_build):
+    eng, st = phold_build
+    text = H.lower_text(eng.run, st, jnp.int64(400 * MILLISECOND))
+    assert H.audit_text(text, H.CONTRACTS["phold"]) == []
+    assert H.ops_histogram(text)["scatter"] == 0
+
+
+def test_injected_scatter_is_rejected(phold_build):
+    """An engine variant smuggling a scatter into the run must fail the
+    phold contract — the auditor sees through the real lowering, not a
+    string fixture."""
+    eng, st = phold_build
+
+    def bad_run(st, stop):
+        out = eng.run(st, stop)
+        idx = jnp.array([1, 3])
+        return dataclasses.replace(
+            out, cpu_free=out.cpu_free.at[idx].add(1))
+
+    text = H.lower_text(bad_run, st, jnp.int64(400 * MILLISECOND))
+    violations = H.audit_text(text, H.CONTRACTS["phold"])
+    assert violations and all("scatter" in v for v in violations)
+
+
+def test_assert_zero_cost_catches_residue():
+    """The shared helper must fail when the 'off' build is not actually
+    identical — checked on toy pytrees so the failure mode is cheap."""
+    def mk(extra):
+        st = {"a": jnp.zeros(4, jnp.int64)}
+        if extra:
+            st["b"] = jnp.zeros(2, jnp.int64)
+        return (lambda s, stop: jax.tree.map(lambda x: x + stop, s)), st
+
+    base_f, base_st = mk(False)
+    on_f, on_st = mk(True)
+    # healthy triple passes and returns the three texts
+    texts = H.assert_zero_cost((base_f, base_st), (base_f, dict(base_st)),
+                               (on_f, on_st), jnp.int64(1),
+                               get_subtree=lambda s: s.get("b"))
+    assert texts["base"] == texts["off"] != texts["on"]
+    # off build with residue fails
+    with pytest.raises(AssertionError):
+        H.assert_zero_cost((base_f, base_st), (on_f, on_st),
+                           (on_f, on_st), jnp.int64(1))
+
+
+def test_recompile_guard(phold_build):
+    eng, st = phold_build
+    stop = 100 * MILLISECOND
+    H.assert_no_recompile(eng.run,
+                          [(st, jnp.int64(stop)), (st, jnp.int64(2 * stop))])
+    with pytest.raises(AssertionError):
+        # dtype flip across calls = a second program
+        H.assert_no_recompile(lambda x: x * 2,
+                              [(jnp.int64(3),), (jnp.float32(3.0),)])
+
+
+@pytest.mark.slow
+def test_all_model_configs_meet_contracts():
+    """The full five-config audit (also the measure_all.sh lint stage):
+    every declared contract holds on today's lowerings."""
+    results = H.audit_all()
+    assert sorted(results) == sorted(H.CONTRACTS)
+    bad = {k: v["violations"] for k, v in results.items() if not v["ok"]}
+    assert not bad, json.dumps(bad, indent=1)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_exits_nonzero_on_findings(tmp_path):
+    from shadow_tpu.tools.lint import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        from shadow_tpu.core import rng as srng
+        def h(key):
+            a = srng.uniform(key)
+            b = srng.randint(key, 0, 4)
+            return a, b
+    """))
+    out = tmp_path / "report.json"
+    rc = main([str(bad), "--no-baseline", "--output", str(out)])
+    assert rc == 1
+    report = json.loads(out.read_text())
+    assert report["new"] == 1
+    assert report["findings"][0]["rule"] == "SL104"
+
+
+def test_cli_exits_zero_on_repo(tmp_path):
+    from shadow_tpu.tools.lint import main
+
+    out = tmp_path / "report.json"
+    rc = main(["--output", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["new"] == 0

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from shadow_tpu.analysis.hlo_audit import assert_zero_cost
 from shadow_tpu.config import parse_config
 from shadow_tpu.core.rng import fault_stream_uniform
 from shadow_tpu.core.timebase import SECOND
@@ -147,6 +148,26 @@ def test_config_xml_fault_element_parsed():
     assert len(cfg.faults) == 1
     assert cfg.faults[0].type == "crash"
     assert cfg.faults[0].start == 5.0
+
+
+# --------------------------------------------------------------- zero cost
+def test_faults_off_is_zero_cost():
+    """A config with no <fault> element builds the same engine program
+    as any other fault-free build — the fault overlay (alive mask,
+    routing rescale, epoch sweeps) must vanish from the lowered HLO
+    entirely, not just be predicated off. Faults bake into the Engine
+    as constants (state only ever carries the always-present
+    fault_epoch scalar), so the shared auditor helper runs without a
+    state subtree probe."""
+    base = build_simulation(parse_config(echo_config()), seed=42)
+    off = build_simulation(parse_config(echo_config()), seed=42)
+    on = build_simulation(parse_config(echo_config(
+        '<fault type="crash" hosts="server" start="5"/>'
+    )), seed=42)
+    assert base.faults is None and off.faults is None
+    assert on.faults is not None
+    assert_zero_cost((base.engine, base.state0), (off.engine, off.state0),
+                     (on.engine, on.state0), jnp.int64(base.stop_ns))
 
 
 # ----------------------------------------------------------------- matrix

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from shadow_tpu.analysis.hlo_audit import assert_zero_cost
 from shadow_tpu.core.events import EventQueue, queue_push
 from shadow_tpu.core.timebase import MILLISECOND, TIME_INVALID
 from shadow_tpu.models import phold
@@ -66,24 +67,16 @@ def _remaining(st):
 def test_overflow_drop_is_zero_cost():
     """spill=0 leaves no residue: leaf-free subtree, identical pytree
     structure, byte-identical lowered HLO vs a default build — so drop
-    mode's compiled program and checkpoint leaf layout never change."""
+    mode's compiled program and checkpoint leaf layout never change.
+    Asserted through the shared auditor helper (analysis.hlo_audit)."""
     eng0, init0 = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
     engz, initz = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
                               spill=0)
     engs, inits = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
                               spill=64)
-    st0, stz, sts = init0(), initz(), inits()
-    assert st0.queues.spill is None and stz.queues.spill is None
-    assert sts.queues.spill is not None
-    assert len(jax.tree.leaves(st0)) == len(jax.tree.leaves(stz))
-    assert len(jax.tree.leaves(sts)) > len(jax.tree.leaves(st0))
-    assert jax.tree.structure(st0) == jax.tree.structure(stz)
-    stop = jnp.int64(STOP)
-    low0 = jax.jit(eng0.run).lower(st0, stop).as_text()
-    lowz = jax.jit(engz.run).lower(stz, stop).as_text()
-    lows = jax.jit(engs.run).lower(sts, stop).as_text()
-    assert low0 == lowz  # HLO op-for-op identical: zero cost when off
-    assert lows != low0
+    assert_zero_cost((eng0, init0()), (engz, initz()), (engs, inits()),
+                     jnp.int64(STOP),
+                     get_subtree=lambda st: st.queues.spill)
 
 
 # ------------------------------------------------------------ bit identity

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from shadow_tpu.analysis.hlo_audit import assert_zero_cost
 from shadow_tpu.core.timebase import SECOND
 from shadow_tpu.models import phold
 from shadow_tpu.obs import (
@@ -46,18 +47,10 @@ def test_trace_off_is_zero_cost():
                               trace=0)
     engt, initt = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
                               trace=32)
-    st0, stz, stt = init0(), initz(), initt()
-    assert st0.trace is None and stz.trace is None
-    assert stt.trace is not None
-    assert len(jax.tree.leaves(st0)) == len(jax.tree.leaves(stz))
-    assert len(jax.tree.leaves(stt)) > len(jax.tree.leaves(st0))
-    # identical pytree structure -> checkpoints interchange
-    assert (jax.tree.structure(st0) == jax.tree.structure(stz))
-    low0 = jax.jit(eng0.run).lower(st0, jnp.int64(STOP)).as_text()
-    lowz = jax.jit(engz.run).lower(stz, jnp.int64(STOP)).as_text()
-    lowt = jax.jit(engt.run).lower(stt, jnp.int64(STOP)).as_text()
-    assert low0 == lowz  # HLO op-for-op identical: zero cost when off
-    assert lowt != low0
+    # the shared auditor helper pins leaf count, pytree structure,
+    # checkpoint leaf paths, and byte-identical lowered HLO
+    assert_zero_cost((eng0, init0()), (engz, initz()), (engt, initt()),
+                     jnp.int64(STOP), get_subtree=lambda st: st.trace)
 
 
 def test_trace_records_reconcile_with_counters():
