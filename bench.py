@@ -536,12 +536,121 @@ def skew_worker():
         print(json.dumps(out), flush=True)
 
 
+def perf_smoke():
+    """CPU PHOLD floor gate (measure_all.sh perf_smoke stage): a small
+    fixed-shape PHOLD on the CPU backend, compared against the
+    checked-in PERF_FLOOR.json. Exits 1 when events/s lands below 70%
+    of the floor — the cheap no-TPU lane that catches hot-path
+    regressions (together with the lint + hlo_audit stages) before a
+    device bench runs. The floor is per-machine-class, deliberately
+    loose; update it consciously with PERF_SMOKE_UPDATE=1."""
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        _REPO, ".jax_cache_cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.timebase import SECOND, seconds
+    from shadow_tpu.models import phold
+
+    n_hosts, stop_s = 256, 4
+    eng, init = phold.build(
+        n_hosts, capacity=CAPACITY, latency_ns=seconds(LATENCY_S),
+        mean_delay_ns=seconds(MEAN_DELAY_S), msgs_per_host=MSGS_PER_HOST,
+        seed=SEED, batched=True,
+    )
+    run = jax.jit(eng.run, donate_argnums=0)
+    # fresh init states alias buffers across leaves (broadcasted
+    # zeros); one per-leaf copy makes them donation-safe
+    fresh = lambda: jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, init()
+    )
+    jax.block_until_ready(run(fresh(), jnp.int64(1 * SECOND)))  # compile
+    t0 = time.perf_counter()
+    st = run(fresh(), jnp.int64(stop_s * SECOND))
+    executed = int(jax.device_get(st.stats.n_executed).sum())
+    wall = time.perf_counter() - t0
+    rate = executed / wall
+
+    floor_path = os.path.join(_REPO, "PERF_FLOOR.json")
+    try:
+        with open(floor_path) as f:
+            floor = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        floor = {}
+    if os.environ.get("PERF_SMOKE_UPDATE") == "1":
+        floor = {
+            "phold_cpu_events_per_s": round(rate, 1),
+            "n_hosts": n_hosts, "stop_s": stop_s,
+            "msgs_per_host": MSGS_PER_HOST, "capacity": CAPACITY,
+        }
+        with open(floor_path, "w") as f:
+            json.dump(floor, f, indent=2)
+            f.write("\n")
+    fl = float(floor.get("phold_cpu_events_per_s", 0.0))
+    ok = fl <= 0 or rate >= 0.7 * fl
+    print(json.dumps({
+        "perf_smoke_events_per_s": round(rate, 1),
+        "perf_smoke_floor": fl,
+        "perf_smoke_events": executed,
+        "perf_smoke_wall_s": round(wall, 3),
+        "perf_smoke_ok": ok,
+    }), flush=True)
+    if not ok:
+        print(f"perf_smoke: {rate:.0f} events/s is below 70% of the "
+              f"PERF_FLOOR.json floor {fl:.0f} — hot-path regression",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def previous_bench() -> tuple[str, float]:
+    """(label, events/s) of the newest checked-in BENCH_r*.json with a
+    parsed primary PHOLD number — the regression anchor every new record
+    embeds and prints its delta against. ("", 0.0) when none exists."""
+    import glob
+    import re
+
+    best = ("", 0.0, -1)
+    for path in glob.glob(os.path.join(_REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        n = int(m.group(1))
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+            value = float(parsed.get("value", 0.0))
+        except (OSError, json.JSONDecodeError, ValueError):
+            continue
+        if value > 0 and n > best[2]:
+            best = (f"r{n:02d}", value, n)
+    return best[0], best[1]
+
+
+def _fmt_rate(v: float) -> str:
+    return f"{v / 1e6:.1f}M" if v >= 1e6 else f"{v / 1e3:.0f}k"
+
+
+def print_delta(out: dict) -> None:
+    """One glanceable regression line on stderr:
+    `phold: 11.9M -> 14.2M events/s, +19.3% vs r05`."""
+    prev_label, prev = out.get("prev_bench", ""), out.get("prev_events_per_s", 0.0)
+    now = out.get("value", 0.0)
+    if not prev or not now:
+        return
+    pct = (now - prev) / prev * 100.0
+    print(f"phold: {_fmt_rate(prev)} -> {_fmt_rate(now)} events/s, "
+          f"{pct:+.1f}% vs {prev_label}", file=sys.stderr, flush=True)
+
+
 def main():
     for flag, fn in (("--tor-worker", tor_worker),
                      ("--tor-churn-worker", tor_churn_worker),
                      ("--btc-worker", btc_worker),
                      ("--phold-worker", phold_worker),
                      ("--phold-big-worker", phold_big_worker),
+                     ("--perf-smoke", perf_smoke),
                      ("--skew-worker", skew_worker)):
         if flag in sys.argv:
             fn()
@@ -602,7 +711,12 @@ def main():
         "device": r["device"],
         "profile": r.get("profile", {}),
     }
+    prev_label, prev_rate = previous_bench()
+    if prev_label:
+        out["prev_bench"] = prev_label
+        out["prev_events_per_s"] = prev_rate
     print(json.dumps(out), flush=True)
+    print_delta(out)
 
     # secondaries enrich the result; every stage re-prints the full dict
     # so the last line is always a complete superset. Ordering is

@@ -51,6 +51,16 @@ for mode in drop spill grow strict; do
     echo "pressure_smoke_strict: unexpected exit $rc" >> "$S"
   fi
 done
+# perf smoke: a small CPU-backend PHOLD against the checked-in
+# PERF_FLOOR.json floor — fails (exit 1) when events/s regresses more
+# than 30%. Together with the lint + hlo_audit stage below this is the
+# no-TPU regression lane; refresh the floor deliberately with
+# `PERF_SMOKE_UPDATE=1 python bench.py --perf-smoke`.
+echo "=== perf_smoke start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"perf_smoke\"}" >> "$R"
+timeout 900 env JAX_PLATFORMS=cpu python bench.py --perf-smoke \
+  >> "$R" 2>> "$S"
+echo "=== perf_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 # static-analysis gate: shadowlint over the package plus the HLO
 # contract audit of every model config. The CLI's JSON report is the
 # stage's $R line; a nonzero exit means new findings or a budget breach.

@@ -132,7 +132,7 @@ def lower_text(run: Callable, state: Any, stop) -> str:
     """StableHLO text of jit(run) lowered at (state, stop)."""
     import jax
 
-    return jax.jit(run).lower(state, stop).as_text()
+    return jax.jit(run).lower(state, stop).as_text()  # shadowlint: no-donate=lowering for inspection only; donation would add input_output_alias lines to every audited contract
 
 
 def _build(name: str):

@@ -25,6 +25,16 @@ way those disciplines have been (or nearly were) broken:
 - SL106 iteration over a ``set`` when building pytrees/collections —
   set order is hash order; pytree leaf order must be deterministic
   across processes (checkpoint layout, multi-host bit-identity).
+- SL107 window-loop entry point jitted without buffer donation — a
+  ``jax.jit`` over a state-threading callable (``run``/``step_window``,
+  or any function whose first parameters include a ``state``/``st``
+  carrier) with no ``donate_argnums``: every window then COPIES the
+  [H, C] queue arrays and rings instead of aliasing them through. The
+  drain hot path's donation (Simulation._wrap) exists precisely to
+  kill those copies; new entry points must donate or declare why they
+  can't with ``# shadowlint: no-donate=<reason>`` (the bare
+  ``disable=SL107`` works too, but the reasoned marker is the
+  documented mechanism — it forces the "why" into the source).
 
 Findings carry a stable key (rule | relpath | enclosing function |
 stripped source line) so the baseline survives unrelated line drift.
@@ -48,7 +58,14 @@ RULES = {
     "SL104": "PRNG key reuse without split",
     "SL105": "mutable default argument or class-body default",
     "SL106": "iteration over a set (nondeterministic order)",
+    "SL107": "window-loop entry point jitted without donate_argnums",
 }
+
+# SL107: callables by these names are window-loop entry points (the
+# engine's state-threading convention), and parameters by these names
+# carry the donated EngineState.
+_ENTRY_NAMES = {"run", "step_window"}
+_STATE_PARAMS = {"state", "st"}
 
 # Functions whose callee-arguments are traced (their bodies are jit
 # scope): jax.jit itself plus the structured control-flow / mapping
@@ -100,6 +117,9 @@ _PRNG_CONSUMERS_SKIP = {
 _PRNG_NAMESPACES = {"srng", "random", "jr", "rng"}
 
 _SUPPRESS_RE = re.compile(r"#\s*shadowlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+# SL107's reasoned exemption: the reason is mandatory (an empty one
+# does not suppress), so every undonated entry point documents itself.
+_NO_DONATE_RE = re.compile(r"#\s*shadowlint:\s*no-donate=(\S.*)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,6 +200,8 @@ class _Linter(ast.NodeVisitor):
         # names referenced as callee arguments of jit wrappers anywhere
         # in the file (pass 1) — their defs are jit scope
         self.jit_marked: set[str] = set()
+        # def name -> parameter names, for SL107's in-file resolution
+        self.func_params: dict[str, tuple[str, ...]] = {}
         # per-function PRNG use tracking: {keyname: [linenos]}
         self._prng_uses: list[dict[str, list[ast.Call]]] = [{}]
 
@@ -349,6 +371,9 @@ class _Linter(ast.NodeVisitor):
                            f"`np.{node.func.attr}(...)` runs on host "
                            f"inside jit scope; use jnp")
 
+        # SL107: jit over a window-loop entry point without donation
+        self._check_jit_donation(node)
+
         # SL103: i32 construction of a time-like expression
         self._check_i32_time(node)
 
@@ -364,6 +389,48 @@ class _Linter(ast.NodeVisitor):
             if isinstance(sub, ast.Name) and sub.id in names:
                 return True
         return False
+
+    # ---------------------------------------------------- SL107 donation
+
+    def _check_jit_donation(self, node: ast.Call) -> None:
+        """jax.jit over a state-threading entry point must donate its
+        carry (or carry a reasoned `# shadowlint: no-donate=` marker)."""
+        if _call_basename(node.func) != "jit" or not node.args:
+            return
+        if isinstance(node.func, ast.Attribute) \
+                and _attr_root(node.func) != "jax":
+            return
+        if any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in node.keywords):
+            return
+        target = node.args[0]
+        why = None
+        if isinstance(target, ast.Lambda):
+            params = tuple(p.arg for p in target.args.args)
+            if params and any(p in _STATE_PARAMS for p in params):
+                why = (f"lambda with state carry "
+                       f"`{', '.join(params)}`")
+        elif isinstance(target, (ast.Name, ast.Attribute)):
+            name = _call_basename(target)
+            if name in _ENTRY_NAMES:
+                why = f"window-loop entry point `{_unparse(target)}`"
+            elif isinstance(target, ast.Name):
+                params = self.func_params.get(name, ())
+                if any(p in _STATE_PARAMS for p in params):
+                    why = (f"`{name}({', '.join(params)})` threads a "
+                           f"state carry")
+        if why is None:
+            return
+        line = getattr(node, "lineno", 1)
+        if 1 <= line <= len(self.lines) \
+                and _NO_DONATE_RE.search(self.lines[line - 1]):
+            return  # reasoned exemption
+        self._emit(
+            "SL107", node,
+            f"jax.jit over {why} without donate_argnums — the window "
+            f"carry is copied every call; donate it (see "
+            f"Simulation._wrap) or mark the line "
+            f"`# shadowlint: no-donate=<reason>`")
 
     # ------------------------------------------------------ SL102 branch
 
@@ -546,6 +613,17 @@ class _JitMarker(ast.NodeVisitor):
 
     def __init__(self) -> None:
         self.marked: set[str] = set()
+        # def name -> parameter names (SL107 resolves in-file callables)
+        self.func_params: dict[str, tuple[str, ...]] = {}
+
+    def _visit_funcdef(self, node) -> None:
+        a = node.args
+        self.func_params[node.name] = tuple(
+            p.arg for p in (a.posonlyargs + a.args))
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_funcdef
+    visit_AsyncFunctionDef = _visit_funcdef
 
     def visit_Call(self, node: ast.Call) -> None:
         if _call_basename(node.func) in _JIT_WRAPPERS:
@@ -585,6 +663,7 @@ def lint_source(src: str, path: str = "<string>") -> list[Finding]:
     marker.visit(tree)
     linter = _Linter(path, src)
     linter.jit_marked = marker.marked
+    linter.func_params = marker.func_params
     linter.visit(tree)
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
 

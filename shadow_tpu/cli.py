@@ -105,6 +105,15 @@ def make_parser() -> argparse.ArgumentParser:
                         "Wider windows mean fewer barriers but coarser "
                         "cross-host packet timing: arrivals inside a "
                         "window are deferred to its end")
+    p.add_argument("--window", default=None, metavar="N|auto",
+                   help="conservative-window width as a TRACED scalar: a "
+                        "number is a fixed width in milliseconds, 'auto' "
+                        "lets a deterministic host-side controller retune "
+                        "the width between windows (no recompiles; "
+                        "docs/11-Performance.md). Like --runahead, widths "
+                        "past the topology's minimum latency coarsen "
+                        "cross-host packet timing; leave the flag off for "
+                        "bit-identical default results")
     p.add_argument("--workers", "-w", type=int, default=None,
                    help="ignored (pthread-era flag; kept for compatibility)")
     p.add_argument("--scheduler-policy", "-p", default=None,
@@ -547,6 +556,58 @@ def main(argv=None) -> int:
     )
     sup_hb = SupervisorHeartbeat(logger, watchdog=sup.watchdog)
 
+    # --window: traced-scalar window widths (fixed N ms or adaptive)
+    wctl = None
+    window_fixed_ns = None
+    if args.window is not None:
+        if sim.pressure is not None:
+            print("error: --window needs --overflow drop or strict (the "
+                  "spill reservoir's boundary harvest steps the static "
+                  "window)", file=sys.stderr)
+            return 2
+        if args.window == "auto":
+            from shadow_tpu.runtime.adaptive import WindowController
+
+            wctl = WindowController(
+                sim.engine.cfg.lookahead, n_hosts=len(sim.names)
+            )
+        else:
+            try:
+                window_fixed_ns = int(float(args.window) * MILLISECOND)
+            except ValueError:
+                print(f"error: --window must be a width in ms or 'auto', "
+                      f"got {args.window!r}", file=sys.stderr)
+                return 2
+            if window_fixed_ns < sim.engine.cfg.lookahead:
+                print(f"error: --window {args.window} is narrower than "
+                      f"the conservative lookahead "
+                      f"({sim.engine.cfg.lookahead} ns); it would only "
+                      "add barriers", file=sys.stderr)
+                return 2
+
+    # single-sync heartbeat harvest + depth-1 dispatch-ahead: every
+    # segment boundary costs ONE batched device_get, and the previous
+    # heartbeat's host-side formatting runs while the device computes
+    # the next segment (docs/11-Performance.md)
+    from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+    harvest = HeartbeatHarvest(sim, tracker=tracker, tdrain=tdrain,
+                               pcap=drain)
+    pending_hb = None  # (fetched bundle, sim_ns, summary) to consume
+
+    def consume_hb():
+        # host-side half of a heartbeat, deferred so it overlaps the
+        # next dispatched segment
+        nonlocal pending_hb
+        if pending_hb is None:
+            return
+        fetched, hb_ns, hb_summary = pending_hb
+        pending_hb = None
+        with _phase("drain"):
+            harvest.consume(fetched, hb_ns)
+            sup_hb.beat(hb_ns, hb_summary)
+            logger.flush()
+
     def write_checkpoint(path=None, **extra_meta):
         # emergency checkpoints go to an explicit side path, NOT into
         # the rotation: a crashing run must never push the last known
@@ -570,8 +631,51 @@ def main(argv=None) -> int:
         with sup:
             while sim_s < stop_s:
                 nxt = min(next_hb, next_ckpt, stop_s)
-                st = sim.run(int(nxt * SECOND), state=st)
-                st.now.block_until_ready()
+                stop_i = int(nxt * SECOND)
+                full_hb = nxt >= next_hb
+                # -- advance to `nxt`: async dispatch on the overlap
+                # path (the fetch below is the segment's only sync);
+                # pressure modes keep run()'s synchronous window loop
+                # (host-side reservoir refills at every boundary)
+                if sim.pressure is not None:
+                    st = sim.run(stop_i, state=st)
+                elif wctl is not None or window_fixed_ns is not None:
+                    # traced-bound windows, one probe per window; in
+                    # auto mode the probe also feeds the controller
+                    while True:
+                        w = (wctl.window_ns if wctl is not None
+                             else window_fixed_ns)
+                        with _phase("step"):
+                            st = sim.dispatch(stop_i, st, window_ns=w)
+                        if wctl is not None:
+                            from shadow_tpu.core.timebase import (
+                                TIME_INVALID,
+                            )
+
+                            now_a, ex_a, dr_a, fill_a = jax.device_get((
+                                st.now, st.stats.n_executed.sum(),
+                                st.queues.drops.sum(),
+                                jnp.mean(
+                                    (st.queues.time != TIME_INVALID)
+                                    .astype(jnp.float32)
+                                ),
+                            ))
+                            wctl.update(int(ex_a), int(dr_a),
+                                        float(fill_a))
+                            now_i = int(now_a)
+                        else:
+                            now_i = int(jax.device_get(st.now))
+                        if now_i >= stop_i:
+                            break
+                else:
+                    st = sim.dispatch(stop_i, st)
+                # queue the harvest extraction behind the segment, then
+                # consume the PREVIOUS heartbeat's fetched bundle while
+                # the device works (the dispatch-ahead overlap)
+                st, bundle = harvest.extract(st, full=full_hb)
+                consume_hb()
+                with _phase("step"):
+                    fetched = harvest.fetch(bundle)
                 sim_s = nxt
                 if sim.pressure is not None and sim.pressure.grow_wanted:
                     # --overflow grow: rebuild the engine at doubled
@@ -593,7 +697,18 @@ def main(argv=None) -> int:
                     ctrl.grow_wanted = False
                     sim.pressure = ctrl
                     st = ctrl.boundary(st)
-                summary_now = sim.summary(st)
+                    # the harvest's jits close over the old engine;
+                    # rebind and take the summary synchronously from
+                    # the re-templated state
+                    harvest.rebind(sim)
+                    summary_now = sim.summary(st)
+                else:
+                    summary_now = harvest.summary_from(fetched)
+                    if sim.pressure is None:
+                        # run()'s loud-overflow probe, from the already-
+                        # fetched bundle (spill/grow never count drops)
+                        sim.check_drops(summary_now["queue_drops"],
+                                        summary_now)
                 sup.pet(sim_seconds=sim_s, **summary_now)
                 sup_hb.observe_margin()
                 if args.validate > 0 and (
@@ -608,26 +723,20 @@ def main(argv=None) -> int:
                     prev_validated_drops = jax.device_get(st.queues.drops)
                     last_validated_windows = summary_now["windows"]
                 if prof is not None:
-                    from shadow_tpu.obs import queue_fill
-
                     prof.observe(
-                        summary_now, queue_fill=queue_fill(st),
+                        summary_now, queue_fill=float(fetched["fill"]),
                         stall_margin_s=(
                             sup.watchdog.margin_s()
                             if sup.watchdog is not None else None
                         ),
                     )
-                if sim_s >= next_hb:
-                    with _phase("drain"):
-                        # trace first: the tracker's [trace] section
-                        # consumes the drain's interval counts
-                        if tdrain is not None:
-                            st = tdrain.drain_state(st)
-                        tracker.heartbeat(st, int(sim_s * SECOND))
-                        sup_hb.beat(int(sim_s * SECOND), summary_now)
-                        logger.flush()
-                        if drain is not None:
-                            drain.drain(st.hosts.net.cap)
+                if full_hb:
+                    # defer the host-side half (trace/pcap decode, the
+                    # tracker's section formatting) to overlap the next
+                    # dispatched segment; the extraction jit already
+                    # reset the trace ring on device
+                    pending_hb = (fetched, int(sim_s * SECOND),
+                                  summary_now)
                     next_hb += hb
                 if sup.take_checkpoint_request():  # SIGUSR1
                     write_checkpoint(on_demand=True)
@@ -642,6 +751,9 @@ def main(argv=None) -> int:
                 if sim_s >= next_ckpt:
                     write_checkpoint()
                     next_ckpt += ck
+            # the final segment's heartbeat has no next dispatch to
+            # overlap with; consume it before the summary
+            consume_hb()
     except InvariantViolation as e:
         # deliberately NO checkpoint here: the state just failed its own
         # consistency checks, and writing it would rotate a known-good
@@ -675,7 +787,13 @@ def main(argv=None) -> int:
     finally:
         # interrupted and failed runs keep their observability output:
         # flush buffered log lines, close every pcap writer, and write
-        # the trace file so captures are valid up to the last drain
+        # the trace file so captures are valid up to the last drain.
+        # A deferred heartbeat bundle holds drained trace records whose
+        # device ring was already reset — consume it first or they're lost
+        try:
+            consume_hb()
+        except Exception:
+            pass
         logger.flush()
         if drain is not None:
             try:

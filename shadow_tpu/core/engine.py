@@ -286,8 +286,18 @@ class EngineConfig:
     # answering a fold carries its segment count, so the peer's fast
     # retransmit fires at the same byte position as unfolded.
     burst: tuple | None = None
+    # Queue-merge kernel for queue_push (core.events): "xla" (default)
+    # lowers the densify + rotate + merge as plain XLA ops; "pallas"
+    # fuses them into one Pallas kernel call (core.merge_pallas,
+    # interpret-mode off-TPU). The two are bit-identical by construction
+    # and pinned so by tests/test_kernel_equivalence.py.
+    kernel: str = "xla"
 
     def __post_init__(self):
+        if self.kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"kernel must be 'xla' or 'pallas', got {self.kernel!r}"
+            )
         # a window of width 0 can never drain an event: the compiled outer
         # loop would spin forever on-device with no Python escape. The
         # reference bounds runahead below by 1ms for the same reason
@@ -485,7 +495,7 @@ class Engine:
         """
         z = jnp.zeros((), jnp.int64)
         if self.cfg.axis_name is None:
-            return queue_push(q, ev, mask, host0), z, z
+            return queue_push(q, ev, mask, host0, self.cfg.kernel), z, z
         cfg = self.cfg
         ax = cfg.axis_name
         h, s = cfg.n_hosts, cfg.n_shards
@@ -500,7 +510,7 @@ class Engine:
         dshard = ev.dst // jnp.int32(h)
         in_range = (dshard >= 0) & (dshard < s)
         is_local = mask & (dshard == my)
-        q = queue_push(q, ev, is_local, host0)
+        q = queue_push(q, ev, is_local, host0, cfg.kernel)
         remaining = mask & in_range & ~is_local
 
         pos = jnp.arange(m, dtype=jnp.int32)
@@ -535,7 +545,10 @@ class Engine:
                 bucket,
             )
             recv_flat = recv.flatten()
-            q2 = queue_push(q, recv_flat, recv_flat.time != TIME_INVALID, host0)
+            q2 = queue_push(
+                q, recv_flat, recv_flat.time != TIME_INVALID, host0,
+                cfg.kernel,
+            )
             sent = jnp.zeros((m,), bool).at[order].set(sel)
             return q2, rem & ~sent, rounds + 1
 
@@ -568,7 +581,7 @@ class Engine:
         )
         flat = initial.flatten()
         valid = flat.time != TIME_INVALID
-        q = queue_push(q, flat, valid, host0)
+        q = queue_push(q, flat, valid, host0, cfg.kernel)
         # start each source's sequence counter past any seq the initial
         # events consumed, so engine-emitted events never reuse a (src, seq)
         # pair — uniqueness is what makes the (time, src, seq) total order
@@ -1515,16 +1528,40 @@ class Engine:
 
         return jax.lax.cond(e != st.fault_epoch, apply, lambda s: s, st)
 
-    def _advance(self, st: EngineState, nxt, stop, host0) -> EngineState:
-        """Open the window [nxt, min(nxt+lookahead, stop)) and drain it."""
-        window_end = jnp.minimum(nxt + self.cfg.lookahead, stop)
+    def _advance(self, st: EngineState, nxt, stop, host0,
+                 window=None) -> EngineState:
+        """Open the window [nxt, min(nxt+window, stop)) and drain it.
+
+        `window` defaults to the static conservative bound
+        (cfg.lookahead). A *wider* traced bound stays causally safe but
+        is NOT bit-identical to the default: `_route` clamps cross-host
+        arrivals up to the window barrier (t_remote = max(t + lat,
+        window_end)), so a barrier farther out defers those arrivals
+        with it — cross-host packet timing coarsens by up to the extra
+        width. That is exactly the documented `--runahead` tradeoff,
+        except the bound here is a traced scalar: adaptive window
+        sizing retunes it between windows with zero recompiles, where
+        --runahead bakes a constant into the program. Same-host events
+        inside the window keep their exact (time, src, seq) order
+        regardless of width. A narrower bound than lookahead is legal
+        too (it just wastes barriers). Runs that must be bit-identical
+        use the default fixed bound (`--window` absent).
+        """
+        if window is None:
+            window = self.cfg.lookahead
+        window_end = jnp.minimum(nxt + window, stop)
         if self._f_crash or self._f_bw:
             st = self._apply_fault_epoch(st, nxt, host0)
         st = self._drain_window(st, window_end, host0)
         return dataclasses.replace(st, now=window_end)
 
-    def step_window(self, st: EngineState, stop, host0=0) -> EngineState:
-        """Advance one conservative window (jittable; no-op when finished)."""
+    def step_window(self, st: EngineState, stop, host0=0,
+                    window=None) -> EngineState:
+        """Advance one conservative window (jittable; no-op when finished).
+
+        `window` optionally widens the window bound past cfg.lookahead
+        as a traced i64 scalar (see `_advance`); None keeps the static
+        default and the default lowering byte-identical."""
         host0 = jnp.asarray(host0, jnp.int32)
         stop = jnp.asarray(stop, jnp.int64)
         nxt = self._next_time(st)
@@ -1535,7 +1572,10 @@ class Engine:
             return dataclasses.replace(st, now=stop)
 
         return jax.lax.cond(
-            nxt < stop, lambda s: self._advance(s, nxt, stop, host0), done, st
+            nxt < stop,
+            lambda s: self._advance(s, nxt, stop, host0, window),
+            done,
+            st,
         )
 
     def run(self, st: EngineState, stop, host0=0) -> EngineState:

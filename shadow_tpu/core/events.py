@@ -11,11 +11,13 @@ form [H, C] device arrays. Rows maintain a **sorted invariant**: slots are
 ordered by the event key (time, src, seq) with empty slots
 (time == TIME_INVALID) at the end. That choice is TPU-motivated: XLA
 scatters with computed indices serialize on TPU (~ms for tens of
-thousands of updates), while row-wise `lax.sort` is fast VPU work — so
-push is implemented as "group incoming events by destination via one flat
-sort, slice each host's contiguous run, concatenate to the row, re-sort
-the row" with no scatter anywhere, and pop-min / frontier extraction are
-free prefix reads of the sorted rows. Bounded capacity drops the
+thousands of updates), while flat `lax.sort` + gathers + row-wise merge
+networks are fast VPU work — so push is implemented as "group incoming
+events by destination via one flat sort, gather each host's contiguous
+run into a dense block, merge the block into the row with a stable
+merge-path network" with no scatter anywhere (see `queue_push` and
+core.merge_pallas), and pop-min / frontier extraction are free prefix
+reads of the sorted rows. Bounded capacity drops the
 *largest*-key events on overflow and accounts them in `drops` — or, when
 the queue carries a `SpillRing` (shadow_tpu.runtime.pressure), lands them
 in the per-host overflow ring instead so a host-side reservoir can
@@ -31,6 +33,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from shadow_tpu.core import merge_pallas
 from shadow_tpu.core.timebase import TIME_INVALID
 
 # Number of i32 payload words carried by every event. The reference carries a
@@ -38,9 +41,9 @@ from shadow_tpu.core.timebase import TIME_INVALID
 # we carry a fixed tuple of words whose meaning depends on `kind`.
 N_ARGS = 6
 
-# Common-round densify width for queue_push (step 3 of its docstring): the
-# filler block it implies (H * MERGE_W lanes) dominates the push's sort
-# traffic, so it is sized to cover every per-destination per-sweep count a
+# Common-round block width for queue_push (step 3 of its docstring): the
+# [H, MERGE_W] incoming block bounds the merge network's per-row compare
+# count, so it is sized to cover every per-destination per-sweep count a
 # steady-state workload produces (Poisson tails at typical loads put
 # P(count > 24) below 1e-8 per host); rarer bursts take the exact
 # full-width fallback round.
@@ -48,14 +51,14 @@ MERGE_W = 24
 
 # Hot-region width for the row-wise merge (step 4): when every row's
 # resident population plus the incoming block fits inside the first
-# HOT_C columns, the merge sorts only [H, HOT_C + W] and leaves the
+# HOT_C columns, the merge touches only [H, HOT_C + W] and leaves the
 # (all-empty) tail untouched — exact, because the sorted-rows invariant
 # makes "population <= HOT_C" mean "all valid slots live in the first
 # HOT_C columns". Large-capacity TCP simulations size C for worst-case
 # bursts (a full receive window in flight) but hold far fewer resident
-# events in steady state, so this turns the dominant per-sweep sort from
-# O(C log^2 C) into O(HOT_C log^2 HOT_C) per row. Rows past the bound
-# fall back to the full-width merge (a lax.cond; no collectives inside).
+# events in steady state, so this bounds the dominant per-sweep merge
+# cost by HOT_C, not C, per row. Rows past the bound fall back to the
+# full-width merge (a lax.cond; no collectives inside).
 HOT_C = 128
 
 
@@ -294,7 +297,8 @@ def queue_pop(
 
 
 def queue_push(
-    q: EventQueue, ev: Events, mask: jax.Array, host0
+    q: EventQueue, ev: Events, mask: jax.Array, host0,
+    kernel: str = "xla",
 ) -> EventQueue:
     """Insert a flat batch of events [M] into their destination queues.
 
@@ -305,18 +309,16 @@ def queue_push(
     counted in `drops` (the reference's heaps are unbounded; we bound and
     account — src/main/core/support/object_counter.c spirit) — unless the
     queue carries a SpillRing, in which case every evicted event lands in
-    the ring (the sorted merge leaves the evicted tail contiguous, so the
+    the ring (the merge leaves the evicted tail contiguous, so the
     capture is one vmapped dynamic_update_slice per field) and only
     ring-overflow events count as drops. With a ring attached the final
     round's admission width is not capped either: extra full-width rounds
     run under a while_loop until every rank is admitted, so no event can
     bypass the ring as an unmaterialized rank-overflow.
 
-    Scatter-AND-gather-free algorithm (TPU: computed-index scatters —
-    and computed-index gathers at this scale: a [H, W]-lane row gather
-    measured 4-5x slower end-to-end than the filler sort it would
-    replace — run far slower than `lax.sort`, so placement is expressed
-    as two flat sorts plus one row-wise merge sort):
+    Scatter-free algorithm (XLA scatters with computed indices serialize
+    on TPU; everything here is one flat sort plus gathers, searchsorted,
+    and a merge network — all budgeted by analysis/hlo_audit.py):
 
     1. One flat multi-key sort groups incoming events by destination in
        (time, src, seq) order. Grouping in key order means the per-row
@@ -324,50 +326,70 @@ def queue_push(
        which events survive overflow then depends only on keys, never on
        batch composition (single-vs-sharded runs stay identical under
        overflow: "keep the C smallest" commutes with batch splits).
-    2. Per-destination counts come from H boundary MARKERS injected into
-       the grouping sort — marker g carries key (g, time=-1) so it sorts
-       immediately before group g's events — whose positions are
-       recovered by one cheap 2-operand sort (markers have unique keys
-       0..H-1; everything else keys H). start[g] = pos[g] - g, and
-       counts are adjacent differences. This is search-free: a
-       jnp.searchsorted over arange(H+1) profiled at ~47% of the whole
-       engine sweep (binary-search whiles with computed-index gathers),
-       vs ~12% for the marker-recovery sort.
-    3. A second flat sort over [grouped incoming | per-row fillers]
-       (exactly W - count fillers per row, so every row's segment is W
-       long) densifies the runs; a plain reshape yields the [H, W]
-       incoming block. W is TWO-LEVEL: the common round runs at a narrow
-       W1 (MERGE_W, covers every per-destination count seen in steady
-       state, and the filler block — the dominant sort cost, H*W lanes —
-       stays small); iff some destination's count exceeds W1, a
-       `lax.cond` fallback round pushes the rank >= W1 remainder at full
-       width. The split is exact, not approximate: the row merge keeps
-       the C smallest keys whatever round events arrive in, so one round
-       vs two produces identical queues (an element dropped at the
-       intermediate truncation has C smaller elements that persist to
-       the end, so it would have been dropped regardless).
-    4. One ROW-WISE `lax.sort` over [H, C + W] with key (time, srcseq)
-       merges each row's block into its C existing slots independently.
-       A row-wise sort of C + W lanes costs O(log^2(C + W)) bitonic
-       passes vs O(log^2(H * (C + W))) for a flat global merge.
-       Truncating to C keeps the smallest keys; the cut tail plus the
-       final round's rank overflow are counted as drops.
+       Only the keys and an i32 position index ride the sort; payload
+       words are gathered afterward through the sorted index, so wide
+       payloads (network-stack models) never inflate the sort operand
+       set. (Earlier revisions packed kind+args into extra i64 sort
+       operands and derived counts from injected boundary markers plus
+       a second recovery sort — profiled against this lowering, the
+       marker machinery and payload operands together roughly double
+       the flat-sort cost, and the jnp.searchsorted below lowers as a
+       scatter-free fori/gather binary search that costs a rounding
+       error next to the sort.)
+    2. Per-destination run starts and counts come from ONE
+       `jnp.searchsorted(sdst, arange(H + 1))` over the grouped
+       destination keys: start[g] = bounds[g], count[g] =
+       bounds[g + 1] - bounds[g]. Rejected events carry key H and fall
+       past bounds[H], so no separate compaction pass is needed.
+    3. Each merge round DENSIFIES its [H, W] incoming block by value
+       gather — lane j of row g reads flat position start[g] + lo + j,
+       masked to a canonical filler (time = i64max, srcseq = i64max,
+       payload = 0) past the row's count. (Earlier revisions densified
+       with a second flat sort over [incoming | H*W fillers]; the
+       gather replaces the dominant sort of the whole push at ~1/300
+       of its cost on the current bench target.)
+    4. The block merges into the resident rows WITHOUT a row sort:
+       rows already hold the sorted invariant (module docstring) apart
+       from a cleared-empty prefix, so a rotation compacts each row's
+       prefix out in one gather, and a stable MERGE-PATH network
+       (broadcast compares + take_along_axis, core.merge_pallas) merges
+       the two sorted sequences exactly as `lax.sort` over their
+       concatenation would — ties resolve resident-first, matching the
+       stable sort it replaces. `kernel="pallas"` runs this densify +
+       rotate + merge fused as one Pallas kernel invocation
+       (interpret-mode off-TPU); `kernel="xla"` (default) runs the
+       identical arithmetic as plain XLA ops. The two are bit-identical
+       by construction and pinned so by test.
+    5. Truncating the merged row to capacity keeps the smallest keys;
+       the cut tail plus the final round's rank overflow are counted as
+       drops (or spill to the ring). Empty slots in the kept region are
+       re-canonicalized (src = seq = kind = args = 0), which both keeps
+       rotation exact on the next push and restores the empties-last
+       invariant behind the engine's prefix-clear of executed events.
 
-    Payload words (kind + args) ride the sorts bit-packed into i64
-    operand pairs. The row re-sort also repairs rows whose invariant was
-    broken by the engine's prefix-clear of executed events.
+    Round structure is TWO-LEVEL: the common round runs at a narrow W1
+    (MERGE_W covers every per-destination count seen in steady state);
+    iff some destination's count exceeds W1, a `lax.cond` fallback round
+    admits the rank >= W1 remainder at full width. The split is exact,
+    not approximate: the merge keeps the C smallest keys whatever round
+    events arrive in, so one round vs two produces identical queues (an
+    element dropped at the intermediate truncation has C smaller
+    elements that persist to the end, so it would have been dropped
+    regardless).
+
+    Payload words (kind + args) ride bit-packed into i64 word pairs.
     """
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
     h, c = q.n_hosts, q.capacity
     m = ev.time.shape[0]
     a = q.args.shape[-1]
     i64max = jnp.iinfo(jnp.int64).max
 
     local = ev.dst - jnp.asarray(host0, jnp.int32)
-    # time >= 0 guards the marker scheme below (markers use time = -1;
-    # sim times are non-negative ns by construction — the engine clamps
-    # dt and latency). A negative-time event is invalid input and is
-    # excluded here like an out-of-shard destination, instead of
-    # silently corrupting the marker-position recovery.
+    # sim times are non-negative ns by construction (the engine clamps
+    # dt and latency); a negative-time event is invalid input and is
+    # excluded here like an out-of-shard destination.
     ok = (
         mask & (local >= 0) & (local < h)
         & (ev.time >= 0) & (ev.time != TIME_INVALID)
@@ -396,72 +418,38 @@ def queue_push(
                 words.append((p & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32))
         return words[:n]
 
-    # -- 1. group incoming (+ one boundary marker per destination, key
-    # (g, time=-1): sorts immediately before group g — real event times
-    # are >= 0) by destination in (time, src, seq) order
-    hosts = jnp.arange(h, dtype=jnp.int32)
-    dkey = jnp.concatenate([jnp.where(ok, local, h), hosts])
-    in_t = jnp.concatenate([ev.time, jnp.full((h,), -1, jnp.int64)])
-    catz = lambda x: jnp.concatenate([x, jnp.zeros((h,), x.dtype)])
-    in_ss = catz(pk(ev.src, ev.seq))
-    in_pay = [
-        catz(p)
-        for p in pack_words([ev.kind] + [ev.args[:, i] for i in range(a)])
-    ]
-    sdst, st, sss, *spay = jax.lax.sort(
-        (dkey, in_t, in_ss, *in_pay), num_keys=3
+    # -- 1. group incoming by destination in (time, src, seq) order;
+    # rejected events key to H and group past every real destination
+    dkey = jnp.where(ok, local, h)
+    flat_idx = jnp.arange(m, dtype=jnp.int32)
+    sdst, st, sss, sidx = jax.lax.sort(
+        (dkey, ev.time, pk(ev.src, ev.seq), flat_idx), num_keys=3
     )
-    mt_len = m + h
 
-    # -- 2. per-destination run starts from the marker positions: one
-    # 2-operand sort brings the H markers (unique keys 0..H-1, in group
-    # order) to the front with their grouped-array positions as payload
-    pos32 = jnp.arange(mt_len, dtype=jnp.int32)
-    is_marker = st == jnp.int64(-1)
-    _, mpos = jax.lax.sort(
-        (jnp.where(is_marker, sdst, h), pos32), num_keys=1
-    )
-    # marker g has g markers before it, so its group's events start at
-    # mpos[g] - g in a marker-free view; counts are adjacent differences
-    n_ok = jnp.sum(ok, dtype=jnp.int32)
-    left_ext = jnp.concatenate([mpos[:h] - hosts, n_ok[None]])
-    count = left_ext[1:] - left_ext[:h]
-
-    # -- 3 + 4. densify + row-wise merge, two-level width (docstring)
-    # rank within group counts the marker at rank 0: real events' rank
-    # is (run rank - 1)
-    rank = pos32 - group_run_starts(sdst) - 1
+    # -- 2. per-destination run bounds in one searchsorted
+    bounds = jnp.searchsorted(
+        sdst, jnp.arange(h + 1, dtype=sdst.dtype), side="left"
+    ).astype(jnp.int32)
+    mpos = bounds[:h]
+    count = bounds[1:] - mpos
 
     def merge_round(q, lo, w, count_tail):
         """Admit rank in [lo, lo + w) into a [H, w] block, merge into the
         queue rows, truncate to capacity. `count_tail`: this is the last
         round — account rank >= lo + w as drops."""
         cnt_r = jnp.clip(count - lo, 0, w)
-        row_in = jnp.where(
-            (sdst < h) & (rank >= lo) & (rank < lo + w), sdst, h
-        )
-        need = w - cnt_r
-        jidx = jnp.arange(w, dtype=jnp.int32)[None, :]
-        row_f = jnp.where(jidx < need[:, None], hosts[:, None], h).reshape(-1)
-
-        nf = h * w
-        cat2 = lambda inc, fill_val: jnp.concatenate(
-            [inc, jnp.full((nf,), fill_val, inc.dtype)]
-        )
-        # single-key sort: within a row's W-slot segment the mix order of
-        # its events and fillers is irrelevant — the row-wise merge below
-        # re-sorts by the real (time, srcseq) key, and fillers
-        # (time=TIME_INVALID) sort to the truncated tail there
-        rkey2, t2, ss2, *pay2 = jax.lax.sort(
-            (
-                jnp.concatenate([row_in, row_f]),
-                cat2(st, i64max),
-                cat2(sss, i64max),
-                *[cat2(p, 0) for p in spay],
-            ),
-            num_keys=1,
-        )
-        blk = lambda x: x[:nf].reshape(h, w)
+        starts = mpos + lo
+        # -- 3. densify the block payload by gather through the sorted
+        # position index (keys densify inside the merge body, which
+        # recomputes the same lane mask)
+        lane = jnp.arange(w, dtype=jnp.int32)
+        gidx = starts[:, None] + lane[None, :]
+        okl = lane[None, :] < cnt_r[:, None]
+        oidx = sidx[jnp.minimum(gidx, m - 1)]
+        bw = [jnp.where(okl, ev.kind[oidx], 0)] + [
+            jnp.where(okl, ev.args[:, i][oidx], 0) for i in range(a)
+        ]
+        bpay = jnp.stack(pack_words(bw), axis=-1)  # [H, w, NW]
 
         def row_merge(q, hc):
             """Merge the incoming [H, w] block into the first `hc` queue
@@ -469,20 +457,21 @@ def queue_push(
             untouched. Exact when every valid slot lives below hc (the
             hot-branch predicate guarantees it; hc == c is the general
             case, where the tail is empty by construction)."""
-            ex_pay = pack_words(
-                [q.kind[:, :hc]] + [q.args[:, :hc, i] for i in range(a)]
-            )  # each [H, hc]
-            mt = jnp.concatenate([q.time[:, :hc], blk(t2)], axis=1)
-            mss = jnp.concatenate(
-                [pk(q.src[:, :hc], q.seq[:, :hc]), blk(ss2)], axis=1
+            qt = q.time[:, :hc]
+            qss = pk(q.src[:, :hc], q.seq[:, :hc])
+            qpay = jnp.stack(
+                pack_words(
+                    [q.kind[:, :hc]] + [q.args[:, :hc, i] for i in range(a)]
+                ),
+                axis=-1,
+            )  # [H, hc, NW]
+            # -- 4. fused densify + rotate + merge (see step 4 above)
+            body = (
+                merge_pallas.fused_merge
+                if kernel == "pallas"
+                else merge_pallas.merge_body
             )
-            mpay = [
-                jnp.concatenate([e, blk(g)], axis=1)
-                for e, g in zip(ex_pay, pay2)
-            ]
-            mt, mss, *mpay = jax.lax.sort(
-                (mt, mss, *mpay), dimension=1, num_keys=2
-            )
+            mt, mss, mpay = body(qt, qss, qpay, st, sss, bpay, starts, cnt_r)
 
             over = jnp.sum(
                 mt[:, hc:] != TIME_INVALID, axis=1, dtype=jnp.int32
@@ -493,14 +482,14 @@ def queue_push(
                     over = over + jnp.maximum(count - lo - w, 0)
                 drops_add = over.astype(jnp.int64)
             else:
-                # the merged row is sorted with empties last, so the
-                # evicted events sit contiguously at the FRONT of the
-                # [H, w] tail: append the whole tail at min(wr, cap) and
+                # the merged row keeps empties last, so the evicted
+                # events sit contiguously at the FRONT of the [H, w]
+                # tail: append the whole tail at min(wr, cap) and
                 # advance the cursor by the valid count only — garbage
                 # beyond it is overwritten by the next append or never
                 # read (slack columns absorb full-ring writes)
                 scap = spill.time.shape[1] - c  # slack == queue capacity
-                starts = jnp.minimum(spill.wr, scap)
+                sstarts = jnp.minimum(spill.wr, scap)
                 put = jax.vmap(
                     lambda row, rec, s: jax.lax.dynamic_update_slice(
                         row, rec, (s,)
@@ -517,23 +506,25 @@ def queue_push(
                     - jnp.maximum(spill.wr - scap, 0)
                 ).astype(jnp.int64)
                 spill = SpillRing(
-                    time=put(spill.time, mt[:, hc:], starts),
-                    srcseq=put(spill.srcseq, mss[:, hc:], starts),
-                    pay=put2(
-                        spill.pay,
-                        jnp.stack([p[:, hc:] for p in mpay], axis=-1),
-                        starts,
-                    ),
+                    time=put(spill.time, mt[:, hc:], sstarts),
+                    srcseq=put(spill.srcseq, mss[:, hc:], sstarts),
+                    pay=put2(spill.pay, mpay[:, hc:, :], sstarts),
                     wr=wr2,
                     n_spilled=spill.n_spilled + over.astype(jnp.int64),
                     n_lost=spill.n_lost + lost,
                     fill_hwm=spill.fill_hwm,
                 )
                 drops_add = lost
-            new_src, new_seq = unpk(mss[:, :hc])
-            words = unpack_words([p[:, :hc] for p in mpay], nw)
+            # -- 5. truncate + re-canonicalize kept empties
+            keep_t = mt[:, :hc]
+            emp = keep_t == TIME_INVALID
+            new_src, new_seq = unpk(jnp.where(emp, 0, mss[:, :hc]))
+            pay_k = jnp.where(emp[:, :, None], 0, mpay[:, :hc, :])
+            words = unpack_words(
+                [pay_k[:, :, i] for i in range(pay_k.shape[-1])], nw
+            )
             glue = lambda head, tail: jnp.concatenate([head, tail], axis=1)
-            new_time = glue(mt[:, :hc], q.time[:, hc:])
+            new_time = glue(keep_t, q.time[:, hc:])
             if spill is not None:
                 fill = jnp.sum(
                     new_time != TIME_INVALID, axis=1, dtype=jnp.int32

@@ -144,6 +144,7 @@ def build(
     batched: bool = False,
     trace: int = 0,
     spill: int = 0,
+    kernel: str = "xla",
 ):
     """Build (engine, initial_state) for an n_hosts PHOLD network.
 
@@ -164,6 +165,7 @@ def build(
         drain_batch=drain_batch,
         trace=trace,
         spill=spill,
+        kernel=kernel,
     )
     net = ConstantNetwork(latency_ns)
     eng = Engine(

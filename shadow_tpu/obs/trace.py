@@ -178,14 +178,28 @@ class TraceDrain:
         self._segs: list[dict[str, np.ndarray]] = []
         self._interval: dict[str, np.ndarray] | None = None
 
+    @staticmethod
+    def gather(ring: TraceRing) -> dict:
+        """Device-array refs for one drain (record columns + write
+        cursor; nothing transferred). The heartbeat-harvest bundle
+        embeds this dict so the trace drain shares the heartbeat's one
+        batched `jax.device_get`; hand the fetched copy to `ingest`."""
+        refs = {f: getattr(ring, f) for f in _FIELDS}
+        refs["wr"] = ring.wr
+        return refs
+
     def drain(self, ring: TraceRing) -> int:
         """Harvest every record written since the last reset; returns the
         number of records drained. Call `reset_ring` (or `drain_state`)
         after, or the next drain re-reads the same rows."""
-        arrs = jax.device_get(tuple(getattr(ring, f) for f in _FIELDS)
-                              + (ring.wr,))
-        cols = {f: np.asarray(a) for f, a in zip(_FIELDS, arrs)}
-        wr = np.asarray(arrs[-1]).astype(np.int64)
+        return self.ingest(jax.device_get(self.gather(ring)))
+
+    def ingest(self, fetched: dict) -> int:
+        """Host-side half of `drain`: fold a fetched (numpy) `gather`
+        dict into the record segments — safe to run while the device
+        computes the next window segment (the overlapped CLI loop)."""
+        cols = {f: np.asarray(fetched[f]) for f in _FIELDS}
+        wr = np.asarray(fetched["wr"]).astype(np.int64)
         h, w = cols["time"].shape
         n = np.minimum(wr, self.cap)
         lost = np.maximum(wr - self.cap, 0)

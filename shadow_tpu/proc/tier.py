@@ -855,9 +855,16 @@ class ProcessTier:
             if sim.pressure is not None:
                 # the tier already steps window-by-window (bounded by
                 # host-side interest points), so the spill reservoir's
-                # harvest/refill hook slots in at every boundary for free
-                st = sim.pressure.boundary(st)
-            now = int(jax.device_get(st.now))
+                # harvest/refill hook slots in at every boundary for
+                # free — sharing the frontier probe's device_get so the
+                # idle refill check costs no extra round-trip
+                now_a, wr = jax.device_get((st.now, st.queues.spill.wr))
+                st = sim._note_owned(
+                    sim.pressure.boundary(st, wr=np.asarray(wr))
+                )
+                now = int(now_a)
+            else:
+                now = int(jax.device_get(st.now))
             self._observe(st)
             if self._udp_zombie_deadline:
                 for zk in [k for k, d in self._udp_zombie_deadline.items()

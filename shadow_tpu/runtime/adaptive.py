@@ -1,0 +1,91 @@
+"""Adaptive conservative-window sizing (`--window auto`).
+
+The engine's conservative window defaults to the topology's minimum
+path latency — the narrowest width that is always safe. For sparse
+workloads (most hosts idle most windows) that width is wasteful: every
+window pays the fixed drain/merge/barrier cost to execute a handful of
+events. A WIDER window is still causally safe — cross-host arrivals
+are clamped up to the window barrier (core.engine._route), the same
+clamp the reference applies at its runahead barrier — it just coarsens
+cross-host packet timing by up to the window width. That is exactly
+the documented `--runahead` tradeoff, with one decisive difference:
+the width here is a TRACED scalar (engine.step_window's `window`
+argument), so retuning it between windows costs zero recompiles where
+`--runahead` bakes a new constant into the program.
+
+This controller picks the multiplier. It is deliberately host-side,
+deterministic, and dumb:
+
+- decisions happen BETWEEN windows from fetched scalars (frontier,
+  executed-event delta, drop delta, queue fill) — never on the traced
+  path, so the compiled program is byte-identical to a fixed-width run;
+- the width is always `base_ns * 2**k`: power-of-two multipliers keep
+  the decision sequence reproducible and the widths monotone in the
+  signals (same simulation + same config => same width sequence,
+  independent of wall clock);
+- widen only when windows run nearly empty (events/window below ~one
+  event per host) AND the queues are slack; shrink immediately on any
+  new drop or rising fill, because a too-wide window admits more
+  in-flight events per barrier and queue capacity is fixed.
+
+Fixed `--window N` (or no flag at all) bypasses this class entirely —
+that path keeps bit-identical results run to run, which is why it
+remains the default.
+"""
+
+from __future__ import annotations
+
+
+class WindowController:
+    """Deterministic between-window width controller.
+
+    `update` consumes cumulative counters (executed, queue_drops) plus
+    the instantaneous queue-fill fraction, and returns the width for
+    the NEXT window. All inputs derive from simulation state, so the
+    width sequence is a pure function of the run — reproducible across
+    hosts and wall-clock conditions.
+    """
+
+    def __init__(self, base_ns: int, *, n_hosts: int, max_mult: int = 64,
+                 fill_grow: float = 0.25, fill_shrink: float = 0.5):
+        if base_ns < 1:
+            raise ValueError(f"base window must be >= 1 ns, got {base_ns}")
+        self.base_ns = int(base_ns)
+        self.mult = 1
+        self.max_mult = int(max_mult)
+        # widen when a window executes fewer events than this: below one
+        # event per host the batched drain sweep is mostly padding and
+        # the barrier overhead dominates
+        self.ev_target = max(int(n_hosts), 1)
+        self.fill_grow = float(fill_grow)
+        self.fill_shrink = float(fill_shrink)
+        self._prev_executed = 0
+        self._prev_drops = 0
+        # (mult, events_in_window, fill) per decision — tests and the
+        # profiler's occupancy story read this
+        self.history: list[tuple[int, int, float]] = []
+
+    @property
+    def window_ns(self) -> int:
+        return self.base_ns * self.mult
+
+    def update(self, executed: int, queue_drops: int, fill: float) -> int:
+        """One decision from the just-finished window's probe; returns
+        the next window's width in ns."""
+        ev = int(executed) - self._prev_executed
+        new_drops = int(queue_drops) - self._prev_drops
+        self._prev_executed = int(executed)
+        self._prev_drops = int(queue_drops)
+        if new_drops > 0 or fill > self.fill_shrink:
+            # pressure: back off immediately (halving converges in
+            # log2(mult) windows, and a drop means capacity was already
+            # exceeded — never ride it out)
+            self.mult = max(1, self.mult // 2)
+        elif (
+            ev < self.ev_target
+            and fill < self.fill_grow
+            and self.mult < self.max_mult
+        ):
+            self.mult *= 2
+        self.history.append((self.mult, ev, float(fill)))
+        return self.window_ns

@@ -171,6 +171,7 @@ class PressureController:
         self.grow_wanted = False
         self._warned_overdue = False
         self._n_args = n_args
+        self._ring_slots = 0  # ring width; refreshed by gather/snapshot
         # optional obs.TraceDrain: spill/refill rows are host-side
         # moments, so the controller injects them as synthetic records
         self.trace_drain = None
@@ -302,19 +303,25 @@ class PressureController:
             per_host[h] += cnt
         return cand, per_host
 
-    def boundary(self, state) -> Any:
+    def boundary(self, state, wr=None) -> Any:
         """Harvest + refill at a window boundary; returns the new state.
 
-        Cheap when idle: one device_get of the [H] write cursor. Under
-        pressure, loops push+harvest until the device holds the per-host
-        smallest keys and the fill watermark is met (or the round bound
-        trips — counted, warned, never silent).
+        Cheap when idle: one device_get of the [H] write cursor — and
+        zero when the caller passes `wr`, the cursor it already fetched
+        in a shared batch (Simulation.run fetches (now, wr) together;
+        the CLI heartbeat harvest rides it in the heartbeat bundle), so
+        the idle refill probe never forces its own device round-trip.
+        Under pressure, loops push+harvest until the device holds the
+        per-host smallest keys and the fill watermark is met (or the
+        round bound trips — counted, warned, never silent).
         """
         ring = state.queues.spill
         if ring is None:
             return state
         self.boundaries += 1
-        wr = np.asarray(jax.device_get(ring.wr))
+        if wr is None:
+            wr = jax.device_get(ring.wr)
+        wr = np.asarray(wr)
         resident = sum(len(hp) for hp in self._heaps)
         if not wr.any() and resident == 0:
             return state
@@ -427,25 +434,44 @@ class PressureController:
                 out[h] = hp[0][0]
         return out
 
-    def snapshot(self, state) -> dict:
-        """Cumulative pressure counters (device + host) for telemetry."""
+    def gather(self, state) -> dict:
+        """Device-array refs for one telemetry snapshot (ring counters
+        only — nothing transferred). The heartbeat-harvest bundle embeds
+        this so the pressure section shares the heartbeat's single
+        batched `jax.device_get` instead of forcing its own round-trip."""
         ring = state.queues.spill
-        if ring is None:
-            return {}
-        spilled, lost, hwm, wr = jax.device_get(
-            (ring.n_spilled, ring.n_lost, ring.fill_hwm, ring.wr)
-        )
+        self._ring_slots = int(ring.time.shape[1])
+        return {
+            "n_spilled": ring.n_spilled, "n_lost": ring.n_lost,
+            "fill_hwm": ring.fill_hwm, "wr": ring.wr,
+        }
+
+    def snapshot_from(self, fetched: dict) -> dict:
+        """Build the telemetry dict from a fetched (numpy) `gather`."""
+        spilled = np.asarray(fetched["n_spilled"])
+        lost = np.asarray(fetched["n_lost"])
+        hwm = np.asarray(fetched["fill_hwm"])
+        wr = np.asarray(fetched["wr"])
+        scap = self._ring_slots
         return {
             "spilled": int(np.sum(spilled)),
             "spill_lost": int(np.sum(lost)),
             "fill_hwm": int(np.max(hwm)) if hwm.size else 0,
-            "pending": int(np.sum(np.minimum(wr, ring.time.shape[1]
-                                             - self.capacity))),
+            "pending": int(np.sum(np.minimum(wr, scap - self.capacity))),
             "refilled": int(np.sum(self.n_refilled)),
             "resident": int(np.sum(self.resident())),
             "overdue": int(self.n_overdue),
             "harvest_seconds": float(self.harvest_seconds),
         }
+
+    def snapshot(self, state) -> dict:
+        """Cumulative pressure counters (device + host) for telemetry
+        (one batched transfer; harvest paths use gather/snapshot_from)."""
+        ring = state.queues.spill
+        if ring is None:
+            return {}
+        self._ring_slots = int(ring.time.shape[1])
+        return self.snapshot_from(jax.device_get(self.gather(state)))
 
     # ------------------------------------------------- checkpoint support
     def serialize(self) -> dict[str, np.ndarray]:
@@ -502,9 +528,16 @@ def run_with_spill(engine, state, stop, controller: PressureController,
                    host0: int = 0):
     """Window-stepped run loop with boundary harvest/refill — the raw
     engine analog of Simulation.run for spill modes (bench + tests)."""
-    step = jax.jit(engine.step_window)
+    step = jax.jit(engine.step_window, donate_argnums=0)
     stop = jnp.int64(stop)
     h0 = jnp.asarray(host0, jnp.int32)
+    # donated carry: copy once to defend the caller's state (it may be
+    # numpy-backed — jnp.asarray zero-copies on CPU, and donating such
+    # a leaf would alias XLA outputs onto caller-owned memory); every
+    # later iteration chains jit/boundary outputs, which are XLA-owned
+    state = jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
+    )
     while int(jax.device_get(state.now)) < int(stop):
         state = step(state, stop, h0)
         state = controller.boundary(state)

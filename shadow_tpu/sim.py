@@ -18,6 +18,7 @@ device mesh axis by dense gid.
 from __future__ import annotations
 
 import dataclasses
+import weakref
 from typing import Any, Callable, Protocol
 
 import jax
@@ -155,11 +156,25 @@ class Simulation:
 
     _jit_run: Any = None
     _jit_step: Any = None
+    _jit_step_w: Any = None  # traced-window variant (--window auto)
+    _owned: Any = None  # weak id-map of donation-safe states we produced
 
     def _wrap(self, fn):
-        """Jit `fn(state, stop, host0)`, under shard_map when sharded."""
+        """Jit `fn(state, stop, host0)`, under shard_map when sharded.
+
+        The state argument is DONATED: the [H, C] queue arrays, staging
+        buffers, and trace/spill rings alias the outputs instead of
+        being copied on every call — which is once per *window* on the
+        window-stepped paths (pressure boundaries, the process tier, the
+        CLI heartbeat loop). Callers own the consequence: a state passed
+        into run()/step_window() is consumed (its buffers are deleted),
+        so `state0` is defended by copy in run()/step_window() and
+        external callers must re-chain the returned state, never reuse
+        the input. Donation changes only input/output aliasing, not the
+        computation: `assert_zero_cost` HLO identities compare donated
+        builds against donated builds and hold unchanged."""
         if self.mesh is None:
-            return jax.jit(lambda st, stop: fn(st, stop, 0))
+            return jax.jit(lambda st, stop: fn(st, stop, 0), donate_argnums=0)
         from jax.sharding import PartitionSpec as P
 
         from shadow_tpu.parallel.mesh import (
@@ -177,6 +192,10 @@ class Simulation:
         if not hasattr(jax, "shard_map"):
             from shadow_tpu.parallel.mesh import pmap_call
 
+            # no donation on the pmap fallback: jax.pmap's donation is
+            # per-device-buffer and interacts badly with the fallback's
+            # reshape/stack plumbing on old jax pins; the fallback is a
+            # compatibility path, not the perf path
             return pmap_call(fn, self.mesh, specs, per, axes)
 
         def sharded(st, stop):
@@ -190,7 +209,8 @@ class Simulation:
                 in_specs=(specs, P()),
                 out_specs=specs,
                 check_vma=False,
-            )
+            ),
+            donate_argnums=0,
         )
 
     strict_overflow: bool = True
@@ -207,18 +227,30 @@ class Simulation:
         events on a full fixed-capacity queue would corrupt simulation
         semantics mid-run. Set strict_overflow=False to accept counted
         drops instead (they remain visible in queues.drops).
+
+        The jitted step DONATES its state input (see `_wrap`): a state
+        passed via `state=` is consumed. `state0` itself is defended by
+        a device-side copy so a Simulation stays re-runnable.
         """
-        st = state if state is not None else self.state0
+        st = self._fresh_state(state)
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
         if self.pressure is not None:
             # spill/grow: the controller must see every window boundary,
             # or an evicted event could miss the window it is due in —
-            # so run window-stepped instead of one fused device loop
-            out = st
+            # so run window-stepped instead of one fused device loop.
+            # The frontier probe and the controller's spill cursor fetch
+            # share one batched device_get per window (the boundary's
+            # idle probe would otherwise force a second round-trip).
+            out = self._note_owned(st)
             stop_i = int(stop)
-            while int(jax.device_get(out.now)) < stop_i:
+            now = int(jax.device_get(out.now))
+            while now < stop_i:
                 out = self.step_window(out, stop_i)
-                out = self.pressure.boundary(out)
+                now, wr = jax.device_get((out.now, out.queues.spill.wr))
+                out = self._note_owned(
+                    self.pressure.boundary(out, wr=np.asarray(wr))
+                )
+                now = int(now)
             return out
         if self._jit_run is None:
             object.__setattr__(self, "_jit_run", self._wrap(self.engine.run))
@@ -228,37 +260,179 @@ class Simulation:
                 out.now.block_until_ready()
         else:
             out = self._jit_run(st, stop)
-        if self.overflow == "strict":
+        out = self._note_owned(out)
+        if self.overflow == "strict" or self.strict_overflow:
             drops = int(jax.device_get(out.queues.drops.sum()))
             if drops > 0:
-                from shadow_tpu.runtime.pressure import QueuePressureError
-
-                raise QueuePressureError(
-                    drops, self.engine.cfg.capacity, self.summary(out)
-                )
-        elif self.strict_overflow:
-            drops = int(jax.device_get(out.queues.drops.sum()))
-            if drops > 0:
-                raise RuntimeError(
-                    f"event queue overflow: {drops} events dropped (per-host "
-                    f"capacity {self.engine.cfg.capacity}); rerun with a "
-                    "larger --capacity, or set strict_overflow=False to "
-                    "accept counted drops"
-                )
+                self.check_drops(drops, self.summary(out))
         return out
 
-    def step_window(self, state, stop_ns: int | None = None):
-        if self._jit_step is None:
-            object.__setattr__(
-                self, "_jit_step", self._wrap(self.engine.step_window)
+    def check_drops(self, drops: int, summary: dict | None = None):
+        """Apply the loud-overflow contract to an already-fetched drop
+        count. run() probes the count itself; the overlapped CLI loop
+        reads it from its heartbeat-harvest bundle instead (the probe
+        would be a second sync) and calls this with the fetched value."""
+        if int(drops) <= 0:
+            return
+        if self.overflow == "strict":
+            from shadow_tpu.runtime.pressure import QueuePressureError
+
+            raise QueuePressureError(
+                int(drops), self.engine.cfg.capacity, summary or {}
             )
+        if self.strict_overflow:
+            raise RuntimeError(
+                f"event queue overflow: {int(drops)} events dropped "
+                f"(per-host capacity {self.engine.cfg.capacity}); rerun "
+                "with a larger --capacity, or set strict_overflow=False "
+                "to accept counted drops"
+            )
+
+    def dispatch(self, stop_ns: int, state, window_ns: int | None = None):
+        """Asynchronously dispatch the next segment; returns the chained
+        state WITHOUT any host<->device sync.
+
+        The async half of the CLI's depth-1 dispatch-ahead: jax queues
+        the computation on the backend and returns immediately, so the
+        host can consume the previous heartbeat's fetched bundle while
+        the device works. No profiler barrier (the CLI times the fetch
+        wait instead), no overflow probe (`check_drops` runs on the
+        harvest bundle's count). `window_ns` selects the traced-window
+        step (one window per call — the adaptive controller decides
+        between windows); None dispatches the fused run-to-stop loop.
+        Pressure modes need run()'s window-boundary refills and are not
+        dispatchable."""
+        if self.pressure is not None:
+            raise ValueError(
+                "dispatch() cannot run spill/grow pressure modes; their "
+                "reservoir refills are host-side window-boundary work — "
+                "use run()"
+            )
+        st = self._fresh_state(state)
+        stop = jnp.int64(stop_ns)
+        if window_ns is None:
+            if self._jit_run is None:
+                object.__setattr__(
+                    self, "_jit_run", self._wrap(self.engine.run)
+                )
+            return self._note_owned(self._jit_run(st, stop))
+        self._ensure_step_w()
+        return self._note_owned(
+            self._jit_step_w(st, stop, jnp.int64(window_ns))
+        )
+
+    def _fresh_state(self, state):
+        """Resolve the state argument for a donating jit call.
+
+        Only states this Simulation itself produced (tracked weakly by
+        identity) pass through to be donated in place — those are
+        XLA-owned jit outputs, safe to alias. Everything else is copied
+        first: `state0` so the Simulation stays re-runnable, and foreign
+        states (checkpoint restores, test-built states) because
+        `jnp.asarray` ZERO-COPIES aligned numpy arrays on CPU — donating
+        such a leaf would let XLA write into (and alias outputs onto)
+        memory numpy still owns, a use-after-free once the numpy side
+        drops it. The copy is once per entry, never per window: chained
+        step outputs are owned and flow through untouched."""
+        if (
+            state is not None
+            and self._owned is not None
+            and self._owned.get(id(state)) is state
+        ):
+            return state
+        src = self.state0 if state is None else state
+        return jax.tree.map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, src
+        )
+
+    def _note_owned(self, state):
+        """Mark `state` as a donation-safe product of this Simulation's
+        own jits (see `_fresh_state`); returns it for chaining."""
+        if self._owned is None:
+            object.__setattr__(self, "_owned", weakref.WeakValueDictionary())
+        self._owned[id(state)] = state
+        return state
+
+    def step_window(self, state, stop_ns: int | None = None,
+                    window_ns: int | None = None):
+        """Advance one window; the input state is consumed (donated).
+
+        `window_ns` widens the conservative window bound past
+        cfg.lookahead as a TRACED scalar — causally safe but with the
+        --runahead timing tradeoff (core.engine._advance); the
+        adaptive-window controller retunes it between windows with
+        zero recompiles. None keeps the fixed cfg.lookahead bound, the
+        byte-identical default lowering, and bit-identical results.
+        """
+        state = self._fresh_state(state)
         stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
+        if window_ns is None:
+            if self._jit_step is None:
+                object.__setattr__(
+                    self, "_jit_step", self._wrap(self.engine.step_window)
+                )
+            args = (state, stop)
+            jit_step = self._jit_step
+        else:
+            self._ensure_step_w()
+            args = (state, stop, jnp.int64(window_ns))
+            jit_step = self._jit_step_w
         if self.profiler is not None:
             with self.profiler.phase("step"):
-                out = self._jit_step(state, stop)
+                out = jit_step(*args)
                 out.now.block_until_ready()
-            return out
-        return self._jit_step(state, stop)
+            return self._note_owned(out)
+        return self._note_owned(jit_step(*args))
+
+    def _ensure_step_w(self):
+        """Build the traced-window step jit once (--window N / auto)."""
+        if self._jit_step_w is not None:
+            return
+        if self.mesh is not None and not hasattr(jax, "shard_map"):
+            raise ValueError(
+                "adaptive windows (--window auto) need the "
+                "shard_map path; the pmap fallback runs fixed "
+                "windows only"
+            )
+        if self.mesh is None:
+            jsw = jax.jit(
+                lambda st, stop, w: self.engine.step_window(
+                    st, stop, 0, window=w
+                ),
+                donate_argnums=0,
+            )
+        else:
+            jsw = self._wrap_windowed()
+        object.__setattr__(self, "_jit_step_w", jsw)
+
+    def _wrap_windowed(self):
+        """shard_map wrapper for the traced-window step (mesh path)."""
+        from jax.sharding import PartitionSpec as P
+
+        from shadow_tpu.parallel.mesh import (
+            hosts_axes, shard_map, state_specs,
+        )
+
+        axes = hosts_axes(self.mesh)
+        per = self.engine.cfg.n_hosts
+        specs = state_specs(
+            self.state0, per * self.engine.cfg.n_shards, axes
+        )
+
+        def sharded(st, stop, w):
+            host0 = jax.lax.axis_index(axes).astype(jnp.int32) * per
+            return self.engine.step_window(st, stop, host0, window=w)
+
+        return jax.jit(
+            shard_map(
+                sharded,
+                mesh=self.mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=specs,
+                check_vma=False,
+            ),
+            donate_argnums=0,
+        )
 
     def summary(self, state) -> dict:
         """Host-side progress snapshot (frontier time, window count,

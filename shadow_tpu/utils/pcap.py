@@ -214,11 +214,25 @@ class CaptureDrain:
         # same classes from the capture files' TOS byte)
         self.stage_counts = {name: 0 for name in STAGE_NAMES.values()}
 
+    @staticmethod
+    def gather(cap: CaptureRing) -> dict:
+        """Device-array refs for one drain (the heartbeat-harvest bundle
+        embeds this so the pcap drain shares the heartbeat's one batched
+        `jax.device_get`; hand the fetched copy to `ingest`)."""
+        return {"t": cap.t, "meta": cap.meta, "wr": cap.wr}
+
     def drain(self, cap: CaptureRing) -> None:
-        t = np.asarray(jax.device_get(cap.t))
-        meta = np.asarray(jax.device_get(cap.meta))
-        wr = np.asarray(jax.device_get(cap.wr))
-        r = cap.t.shape[1]  # derive from the ring itself
+        self.ingest(jax.device_get(self.gather(cap)))
+
+    def ingest(self, fetched: dict) -> None:
+        """Host-side half of `drain`: decode a fetched (numpy) `gather`
+        dict into the per-host pcap files. The ring is cursor-tracked
+        (never reset on device), so ingesting the same snapshot twice is
+        a no-op."""
+        t = np.asarray(fetched["t"])
+        meta = np.asarray(fetched["meta"])
+        wr = np.asarray(fetched["wr"])
+        r = t.shape[1]  # derive from the ring itself
         for gid, w in self.writers.items():
             new = int(wr[gid])
             start = self.last_wr[gid]

@@ -99,29 +99,47 @@ class Snapshot:
                         z(), z())
 
 
-def snapshot(st) -> Snapshot:
-    """Pull the cumulative counters from an EngineState."""
+def snapshot_refs(st) -> dict:
+    """Device-array refs (reductions applied, nothing transferred) for
+    one Snapshot — the gather half of `snapshot`. The heartbeat-harvest
+    bundle embeds this dict so the whole heartbeat costs ONE batched
+    `jax.device_get` instead of one transfer per counter."""
+    import jax.numpy as jnp
+
     net = st.hosts.net
     socks = net.sockets
     retx = (
-        np.array(jax.device_get(net.tcb.n_retx.sum(axis=1)))
+        net.tcb.n_retx.sum(axis=1)
         if net.tcb is not None
-        else np.zeros(socks.rx_bytes.shape[0], np.int64)
+        else jnp.zeros((socks.rx_bytes.shape[0],), jnp.int64)
     )
-    return Snapshot(
-        rx=np.array(jax.device_get(socks.rx_bytes.sum(axis=1))),
-        tx=np.array(jax.device_get(socks.tx_bytes.sum(axis=1))),
-        rx_wire=np.array(jax.device_get(net.nic_rx.wire)),
-        tx_wire=np.array(jax.device_get(net.nic_tx.wire)),
-        rx_pkts=np.array(jax.device_get(net.nic_rx.pkts)),
-        tx_pkts=np.array(jax.device_get(net.nic_tx.pkts)),
-        retx=retx,
-        events=np.array(jax.device_get(st.stats.n_executed)),
-        drops=np.array(jax.device_get(st.queues.drops)).astype(np.int64),
-        tail_drops=np.array(jax.device_get(net.nic_rx.drops)),
-        fault_drops=np.array(jax.device_get(st.stats.n_fault_dropped)),
-        quarantined=np.array(jax.device_get(st.stats.n_quarantined)),
-    )
+    return {
+        "rx": socks.rx_bytes.sum(axis=1),
+        "tx": socks.tx_bytes.sum(axis=1),
+        "rx_wire": net.nic_rx.wire,
+        "tx_wire": net.nic_tx.wire,
+        "rx_pkts": net.nic_rx.pkts,
+        "tx_pkts": net.nic_tx.pkts,
+        "retx": retx,
+        "events": st.stats.n_executed,
+        "drops": st.queues.drops,
+        "tail_drops": net.nic_rx.drops,
+        "fault_drops": st.stats.n_fault_dropped,
+        "quarantined": st.stats.n_quarantined,
+    }
+
+
+def snapshot_from(fetched: dict) -> Snapshot:
+    """Build a Snapshot from a fetched (numpy) `snapshot_refs` dict."""
+    a = {k: np.asarray(v) for k, v in fetched.items()}
+    a["drops"] = a["drops"].astype(np.int64)
+    return Snapshot(**a)
+
+
+def snapshot(st) -> Snapshot:
+    """Pull the cumulative counters from an EngineState (one batched
+    transfer)."""
+    return snapshot_from(jax.device_get(snapshot_refs(st)))
 
 
 class SupervisorHeartbeat:
@@ -224,6 +242,9 @@ class Tracker:
         # all-zero delta rows or divide the interval math by nothing
         self._prev_ns: int | None = None
         self._emitted_headers = False
+        # (queue capacity, socket capacity, per-host state bytes) — pure
+        # shape math captured by gather() so heartbeat_from is state-free
+        self._ram_static: tuple[int, int, int] | None = None
 
     def _info(self, name: str) -> tuple[str, ...]:
         return self.info_of.get(name, self.log_info)
@@ -231,10 +252,71 @@ class Tracker:
     def _level(self, name: str) -> str:
         return self.level_of.get(name, "message")
 
+    def gather(self, st) -> dict:
+        """Device-array refs for everything one heartbeat consumes —
+        node counters, and the socket/ram/pressure sections when any
+        host enables them. Per-host reductions happen on device; the
+        caller fetches the whole dict in ONE `jax.device_get` (the
+        heartbeat-harvest bundle) and hands it to `heartbeat_from`."""
+        import math
+
+        import jax.numpy as jnp
+
+        from shadow_tpu.core.timebase import TIME_INVALID
+
+        refs: dict[str, Any] = {"snap": snapshot_refs(st)}
+        if any("socket" in self._info(n) for n in self.names):
+            net = st.hosts.net
+            socks = net.sockets
+            refs["socket"] = {
+                "proto": socks.proto, "lport": socks.local_port,
+                "phost": socks.peer_host, "pport": socks.peer_port,
+                "rx": socks.rx_bytes, "tx": socks.tx_bytes,
+                "retx": (net.tcb.n_retx if net.tcb is not None
+                         else jnp.zeros_like(socks.proto)),
+            }
+        if any("ram" in self._info(n) for n in self.names):
+            refs["ram"] = {
+                "q_used": jnp.sum(
+                    st.queues.time != TIME_INVALID, axis=1,
+                    dtype=jnp.int32,
+                ),
+                "s_used": jnp.sum(
+                    st.hosts.net.sockets.proto != 0, axis=1,
+                    dtype=jnp.int32,
+                ),
+            }
+            # static shape math, not a transfer: ride it in the bundle
+            # so heartbeat_from never needs the state
+            self._ram_static = (
+                int(st.queues.time.shape[1]),
+                int(st.hosts.net.sockets.proto.shape[1]),
+                sum(
+                    math.prod(l.shape) * l.dtype.itemsize
+                    for l in jax.tree.leaves(st)
+                ) // max(len(self.names), 1),
+            )
+        if self.pressure is not None and (
+            getattr(st.queues, "spill", None) is not None
+        ):
+            refs["pressure"] = self.pressure.gather(st)
+        return refs
+
     def heartbeat(self, st, sim_ns: int) -> None:
+        """Gather + fetch + emit in one call (one batched transfer).
+        The overlapped CLI loop instead calls `gather` inside its
+        harvest bundle and `heartbeat_from` on the fetched copy."""
         if self._prev_ns is not None and sim_ns <= self._prev_ns:
             return  # zero-length interval: nothing can have accumulated
-        cur = snapshot(st)
+        self.heartbeat_from(jax.device_get(self.gather(st)), sim_ns)
+
+    def heartbeat_from(self, fetched: dict, sim_ns: int) -> None:
+        """Emit one heartbeat from a fetched (numpy) `gather` dict —
+        pure host-side work, safe to run while the device computes the
+        next window segment."""
+        if self._prev_ns is not None and sim_ns <= self._prev_ns:
+            return  # zero-length interval: nothing can have accumulated
+        cur = snapshot_from(fetched["snap"])
         any_socket = any("socket" in self._info(n) for n in self.names)
         if not self._emitted_headers:
             self.logger.log(sim_ns, "tracker", "message", NODE_HEADER)
@@ -277,30 +359,27 @@ class Tracker:
                 f"{d(cur.drops[i], p.drops[i])},"
                 f"{d(cur.tail_drops[i], p.tail_drops[i])}",
             )
-        if any_socket:
-            self._socket_lines(st, sim_ns, t_s)
-        if any("ram" in self._info(n) for n in self.names):
-            self._ram_lines(st, sim_ns, t_s)
+        if any_socket and "socket" in fetched:
+            self._socket_lines(fetched["socket"], sim_ns, t_s)
+        if "ram" in fetched:
+            self._ram_lines(fetched["ram"], sim_ns, t_s)
         if self.faults is not None:
             self._fault_lines(cur, sim_ns, t_s)
         if self.trace is not None:
             self._trace_lines(sim_ns, t_s)
-        if self.pressure is not None:
-            self._pressure_line(st, sim_ns, t_s)
+        if self.pressure is not None and "pressure" in fetched:
+            self._pressure_line(fetched["pressure"], sim_ns, t_s)
         self.prev = cur
         self._prev_ns = sim_ns
 
-    def _pressure_line(self, st, sim_ns: int, t_s: int) -> None:
+    def _pressure_line(self, fetched: dict, sim_ns: int, t_s: int) -> None:
         """One aggregate queue-pressure row per interval (like the
         [supervisor] section: whole-run, not per-host — pressure is a
         capacity-sizing signal, and the per-host detail lives in the
         trace ops and the validator). Counters are cumulative on the
         controller/ring; this diffs them against the previous beat."""
-        ring = getattr(st.queues, "spill", None)
-        if ring is None:
-            return
-        cur = self.pressure.snapshot(st)
-        n_spilled = np.array(jax.device_get(ring.n_spilled))
+        cur = self.pressure.snapshot_from(fetched)
+        n_spilled = np.asarray(fetched["n_spilled"])
         prev = self._prev_pressure or {}
         prev_sp = prev.get("per_host_spilled")
         d_sp = n_spilled - (prev_sp if prev_sp is not None else 0)
@@ -359,24 +438,15 @@ class Tracker:
                 f"{t_s},{name},{fd},{qr},{dt:.3f}",
             )
 
-    def _ram_lines(self, st, sim_ns: int, t_s: int) -> None:
+    def _ram_lines(self, fetched: dict, sim_ns: int, t_s: int) -> None:
         """Per-host state occupancy (the reference's [ram] allocation
         heartbeat, tracker.c ram section, reinterpreted for fixed-width
         device arrays: used slots vs capacity plus the per-host share of
-        the resident state bytes)."""
-        import math
-
-        q_time = np.array(jax.device_get(st.queues.time))
-        used = (q_time < np.iinfo(np.int64).max).sum(axis=1)
-        cap = q_time.shape[1]
-        proto = np.array(jax.device_get(st.hosts.net.sockets.proto))
-        s_used = (proto != 0).sum(axis=1)
-        s_cap = proto.shape[1]
-        n = len(self.names)
-        state_bytes = sum(
-            math.prod(l.shape) * l.dtype.itemsize
-            for l in jax.tree.leaves(st)
-        ) // max(n, 1)
+        the resident state bytes). Occupancy reduces on device in
+        `gather`; the static capacities/bytes ride `_ram_static`."""
+        used = np.asarray(fetched["q_used"])
+        s_used = np.asarray(fetched["s_used"])
+        cap, s_cap, state_bytes = self._ram_static
         for i, name in enumerate(self.names):
             if "ram" not in self._info(name):
                 continue
@@ -387,20 +457,14 @@ class Tracker:
                 f"{state_bytes}",
             )
 
-    def _socket_lines(self, st, sim_ns: int, t_s: int) -> None:
-        net = st.hosts.net
-        socks = net.sockets
-        proto = np.array(jax.device_get(socks.proto))
-        lport = np.array(jax.device_get(socks.local_port))
-        phost = np.array(jax.device_get(socks.peer_host))
-        pport = np.array(jax.device_get(socks.peer_port))
-        rx = np.array(jax.device_get(socks.rx_bytes))
-        tx = np.array(jax.device_get(socks.tx_bytes))
-        retx = (
-            np.array(jax.device_get(net.tcb.n_retx))
-            if net.tcb is not None
-            else np.zeros_like(proto)
-        )
+    def _socket_lines(self, fetched: dict, sim_ns: int, t_s: int) -> None:
+        proto = np.asarray(fetched["proto"])
+        lport = np.asarray(fetched["lport"])
+        phost = np.asarray(fetched["phost"])
+        pport = np.asarray(fetched["pport"])
+        rx = np.asarray(fetched["rx"])
+        tx = np.asarray(fetched["tx"])
+        retx = np.asarray(fetched["retx"])
         pname = {0: "NONE", 1: "UDP", 2: "TCP"}
         for i, name in enumerate(self.names):
             if "socket" not in self._info(name):

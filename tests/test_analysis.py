@@ -200,6 +200,52 @@ def test_sl106_set_iteration():
     assert _rules(fs) == ["SL106"] and len(fs) == 2
 
 
+def test_sl107_undonated_entry_points():
+    # all three resolution paths: named entry point, in-file def with a
+    # state parameter, and a state-carrying lambda
+    fs = _lint("""
+        import jax
+        def step_window(state, stop, base, window):
+            return state
+        def drive(st, stop):
+            return st
+        j1 = jax.jit(step_window)
+        j2 = jax.jit(drive)
+        j3 = jax.jit(lambda st, stop: st)
+    """)
+    assert _rules(fs) == ["SL107"] and len(fs) == 3
+
+
+def test_sl107_donated_clean():
+    fs = _lint("""
+        import jax
+        def run(state, stop):
+            return state
+        j1 = jax.jit(run, donate_argnums=0)
+        j2 = jax.jit(lambda st, stop: st, donate_argnames="st")
+        j3 = jax.jit(lambda x, y: x + y)  # no state carry at all
+    """)
+    assert fs == []
+
+
+def test_sl107_no_donate_exemption_needs_reason():
+    # the reasoned marker suppresses; a bare `no-donate=` does not
+    fs = _lint("""
+        import jax
+        def run(state, stop):
+            return state
+        j = jax.jit(run)  # shadowlint: no-donate=pmap fallback stacks outputs
+    """)
+    assert fs == []
+    fs = _lint("""
+        import jax
+        def run(state, stop):
+            return state
+        j = jax.jit(run)  # shadowlint: no-donate=
+    """)
+    assert _rules(fs) == ["SL107"]
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng
