@@ -536,6 +536,124 @@ def skew_worker():
         print(json.dumps(out), flush=True)
 
 
+def multichip_worker():
+    """Weak-scaling PHOLD over an 8-device mesh — MULTICHIP_r*.json
+    carries data now, not just a smoke bit.
+
+    Three stages, each printed as a JSON superset the moment it lands
+    (same contract as the other workers):
+
+      1. bit-identity: a small sharded PHOLD (8 shards) vs the
+         single-device engine at the same total host count — the
+         determinism contract, recorded as pass/fail;
+      2. mid tier: 16k hosts/device x 8 = 131072 hosts;
+      3. the 1M-host tier: 128k hosts/device x 8 = 1048576 hosts
+         (ROADMAP "millions of users" north star shape), budget
+         permitting.
+
+    The final superset is also written to the next MULTICHIP_r*.json.
+    On CPU the 8 devices are forced (virtual); events/s then measures
+    the sharded program's single-core throughput — the weak-scaling
+    *shape* (per-shard host count, collective structure) is identical
+    to the real-chip run."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    _enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.timebase import SECOND, seconds
+    from shadow_tpu.models import phold
+    from shadow_tpu.obs import WindowProfiler
+    from shadow_tpu.parallel import mesh as pmesh
+
+    n_dev = 8
+    out = {
+        "mc_devices": n_dev,
+        "mc_device": str(jax.devices()[0].device_kind),
+        # the path actually executed (tests pin that no jax.pmap runs
+        # unless this says "pmap")
+        "mc_spmd_path": pmesh.select_spmd("auto"),
+    }
+    prof = WindowProfiler()
+
+    def sharded(per, **kw):
+        eng, init = phold.build(
+            per, axis_name=pmesh.HOSTS_AXIS, n_shards=n_dev, **kw)
+        m = pmesh.make_mesh(n_dev)
+        return pmesh.build_sharded(eng, init, m, per)
+
+    # -- 1. bit-identity, small shape --------------------------------
+    with prof.phase("identity"):
+        kw = dict(seed=SEED, capacity=32, msgs_per_host=4)
+        eng1, init1 = phold.build(64, **kw)
+        st1 = jax.jit(eng1.run)(init1(), jnp.int64(SECOND))
+        initN, runN, _ = sharded(8, **kw)
+        stN = runN(initN(), jnp.int64(SECOND))
+        out["mc_bit_identical"] = bool(
+            st1.hosts.n_received.tolist() == stN.hosts.n_received.tolist()
+            and st1.src_seq.tolist() == stN.src_seq.tolist()
+            and (st1.queues.time.sort(axis=1)
+                 == stN.queues.time.sort(axis=1)).all()
+        )
+    print(json.dumps(out), flush=True)
+
+    # -- 2./3. weak scaling ------------------------------------------
+    def tier(tag, per, stop_ns, msgs):
+        with prof.phase(f"{tag}_build"):
+            initN, runN, _ = sharded(
+                per, seed=SEED, capacity=16, msgs_per_host=msgs,
+                latency_ns=seconds(LATENCY_S),
+                mean_delay_ns=seconds(MEAN_DELAY_S))
+            st = initN()
+            # warm the compile on a short horizon
+            jax.block_until_ready(runN(st, jnp.int64(stop_ns // 8)))
+        st = initN()
+        t0 = time.perf_counter()
+        with prof.phase(f"{tag}_step"):
+            st = runN(st, jnp.int64(stop_ns))
+            executed = int(jax.device_get(st.stats.n_executed).sum())
+        wall = time.perf_counter() - t0
+        out.update({
+            f"{tag}_hosts_per_shard": per,
+            f"{tag}_n_hosts": per * n_dev,
+            f"{tag}_events": executed,
+            f"{tag}_wall_s": round(wall, 3),
+            f"{tag}_events_per_s": round(executed / wall, 1),
+            f"{tag}_windows": int(st.stats.n_windows),
+            f"{tag}_cross_shard_events": int(
+                jax.device_get(st.stats.n_cross_shard).sum()),
+        })
+        out["mc_profile"] = {
+            name: round(p["total_s"], 3)
+            for name, p in prof.summary()["phases"].items()
+        }
+        print(json.dumps(out), flush=True)
+
+    stop_ns = int(float(os.environ.get("MULTICHIP_STOP_S", "0.5")) * SECOND)
+    tier("mc_mid", 16384, stop_ns, 2)
+    if _remaining() > 120:
+        tier("mc_1m", 131072, stop_ns, 1)
+    else:
+        print("bench: skipping 1M tier (budget exhausted)", file=sys.stderr)
+
+    # land the superset in the next MULTICHIP_r*.json
+    import glob
+    import re as _re
+
+    nums = [int(m.group(1)) for p in
+            glob.glob(os.path.join(_REPO, "MULTICHIP_r*.json"))
+            if (m := _re.search(r"MULTICHIP_r(\d+)\.json$", p))]
+    path = os.path.join(
+        _REPO, f"MULTICHIP_r{max(nums, default=0) + 1:02d}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    _stamp(f"multichip results -> {path}")
+
+
 def perf_smoke():
     """CPU PHOLD floor gate (measure_all.sh perf_smoke stage): a small
     fixed-shape PHOLD on the CPU backend, compared against the
@@ -651,6 +769,7 @@ def main():
                      ("--phold-worker", phold_worker),
                      ("--phold-big-worker", phold_big_worker),
                      ("--perf-smoke", perf_smoke),
+                     ("--multichip-worker", multichip_worker),
                      ("--skew-worker", skew_worker)):
         if flag in sys.argv:
             fn()

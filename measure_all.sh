@@ -22,6 +22,11 @@ run btc       1800 --btc-worker
 run phold     900  --phold-worker    BENCH_STOP_S=20
 run phold16k  1200 --phold-big-worker BENCH_STOP_S=20
 run skew      900  --skew-worker
+# weak-scaling multichip bench on a forced 8-device CPU mesh: sharded
+# events/s, per-shard host count, and the bit-identity-vs-single-device
+# pass/fail; the worker also writes the superset to MULTICHIP_r*.json
+run multichip 2400 --multichip-worker JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 BENCH_BUDGET_S=2300
 # fast observability smoke: a short traced+profiled run through the CLI
 # plus the Chrome-trace exporter; only the summary JSON line joins $R
 # (stderr notes and heartbeat lines go to the stamp log)
@@ -66,7 +71,11 @@ echo "=== perf_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 # stage's $R line; a nonzero exit means new findings or a budget breach.
 echo "=== lint start $(date +%H:%M:%S)" >> "$S"
 echo "{\"stage\": \"lint\"}" >> "$R"
-timeout 900 env JAX_PLATFORMS=cpu python -m shadow_tpu.tools.lint \
+# the forced 8-device count lets the phold_sharded contract lower (it
+# skips, not fails, when fewer devices are present)
+timeout 1200 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m shadow_tpu.tools.lint \
   --hlo-audit all --output measure_lint.json 2>> "$S" \
   && cat measure_lint.json >> "$R"
 echo "=== lint exit=$? $(date +%H:%M:%S)" >> "$S"

@@ -35,6 +35,13 @@ way those disciplines have been (or nearly were) broken:
   can't with ``# shadowlint: no-donate=<reason>`` (the bare
   ``disable=SL107`` works too, but the reasoned marker is the
   documented mechanism — it forces the "why" into the source).
+- SL108 collective call inside a ``while_loop``/``cond`` predicate —
+  jax 0.4.x's experimental shard_map under ``check_rep=False``
+  miscompiles collectives lowered into loop/branch predicates: device
+  0's carried state leaks to every shard (the PR-1 pmap-fallback bug;
+  docs/12-Sharding.md post-mortem). The engine computes every such
+  flag in the loop BODY and threads it through the carry
+  (``core.engine._drain_flag``); this rule pins that structurally.
 
 Findings carry a stable key (rule | relpath | enclosing function |
 stripped source line) so the baseline survives unrelated line drift.
@@ -59,6 +66,7 @@ RULES = {
     "SL105": "mutable default argument or class-body default",
     "SL106": "iteration over a set (nondeterministic order)",
     "SL107": "window-loop entry point jitted without donate_argnums",
+    "SL108": "collective call inside a while_loop/cond predicate",
 }
 
 # SL107: callables by these names are window-loop entry points (the
@@ -115,6 +123,18 @@ _PRNG_CONSUMERS_SKIP = {
     "clone",
 }
 _PRNG_NAMESPACES = {"srng", "random", "jr", "rng"}
+
+# SL108: collective primitives whose lowering into a while_loop cond or
+# a lax.cond predicate triggers the 0.4.x experimental-shard_map
+# check_rep=False miscompile (predicate re-evaluated per shard off
+# device 0's carry), plus the engine's in-package reduction wrappers
+# built directly on them — a `self._gany(...)` in a predicate is the
+# same bug one call away.
+_COLLECTIVES = {
+    "psum", "pmin", "pmax", "pmean", "psum_scatter",
+    "all_to_all", "ppermute", "all_gather", "pshuffle", "pbroadcast",
+}
+_COLLECTIVE_WRAPPERS = {"_gany", "_gmin", "_gsum"}
 
 _SUPPRESS_RE = re.compile(r"#\s*shadowlint:\s*disable(?:=([A-Z0-9,\s]+))?")
 # SL107's reasoned exemption: the reason is mandatory (an empty one
@@ -185,10 +205,12 @@ def _is_int32_expr(node: ast.AST) -> bool:
 class _Scope:
     """Per-function lint context threaded through the visitor."""
 
-    def __init__(self, name: str, jitted: bool, params: set[str]):
+    def __init__(self, name: str, jitted: bool, params: set[str],
+                 predicate: bool = False):
         self.name = name
         self.jitted = jitted
         self.params = params  # traced-candidate parameter names
+        self.predicate = predicate  # body lowers as a while_loop cond
 
 
 class _Linter(ast.NodeVisitor):
@@ -200,6 +222,12 @@ class _Linter(ast.NodeVisitor):
         # names referenced as callee arguments of jit wrappers anywhere
         # in the file (pass 1) — their defs are jit scope
         self.jit_marked: set[str] = set()
+        # names passed as while_loop's cond_fun (pass 1) — their defs
+        # lower as loop predicates (SL108 scope)
+        self.pred_marked: set[str] = set()
+        # SL108 nodes already reported (a lax.cond inside a predicate
+        # function would otherwise double-fire)
+        self._sl108_seen: set[int] = set()
         # def name -> parameter names, for SL107's in-file resolution
         self.func_params: dict[str, tuple[str, ...]] = {}
         # per-function PRNG use tracking: {keyname: [linenos]}
@@ -286,7 +314,8 @@ class _Linter(ast.NodeVisitor):
                            f"mutable default `{_unparse(d)}` in "
                            f"{node.name}() is shared across calls; use "
                            f"None + in-body construction (or a tuple)")
-        self.scopes.append(_Scope(node.name, jitted, params))
+        self.scopes.append(_Scope(node.name, jitted, params,
+                                  predicate=node.name in self.pred_marked))
         self._prng_uses.append({})
         self.generic_visit(node)
         self._flush_prng()
@@ -371,6 +400,9 @@ class _Linter(ast.NodeVisitor):
                            f"`np.{node.func.attr}(...)` runs on host "
                            f"inside jit scope; use jnp")
 
+        # SL108: collectives lowered into a loop/branch predicate
+        self._check_pred_collective(node, base)
+
         # SL107: jit over a window-loop entry point without donation
         self._check_jit_donation(node)
 
@@ -431,6 +463,56 @@ class _Linter(ast.NodeVisitor):
             f"carry is copied every call; donate it (see "
             f"Simulation._wrap) or mark the line "
             f"`# shadowlint: no-donate=<reason>`")
+
+    # --------------------------------------------- SL108 pred collective
+
+    @staticmethod
+    def _is_collective_call(node: ast.Call) -> bool:
+        base = _call_basename(node.func)
+        if base in _COLLECTIVE_WRAPPERS:
+            return True  # self._gany / eng._gmin — psum/pmin one call away
+        if base not in _COLLECTIVES:
+            return False
+        if isinstance(node.func, ast.Attribute):
+            return _attr_root(node.func) in ("lax", "jax")
+        return True  # `from jax.lax import psum` style
+
+    def _sl108_emit(self, node: ast.Call) -> None:
+        if id(node) in self._sl108_seen:
+            return
+        self._sl108_seen.add(id(node))
+        self._emit(
+            "SL108", node,
+            f"collective `{_unparse(node.func)}` lowers into a "
+            f"while/cond predicate — 0.4.x experimental shard_map "
+            f"(check_rep=False) leaks device 0's carry to every shard "
+            f"there; compute the flag in the loop body and carry it "
+            f"(core.engine._drain_flag)")
+
+    def _check_pred_collective(self, node: ast.Call, base: str) -> None:
+        # (a) any collective lexically inside a cond-function body
+        if self._is_collective_call(node) \
+                and any(s.predicate for s in self.scopes):
+            self._sl108_emit(node)
+        # (b) inline-lambda cond: while_loop(lambda c: ..., body, init)
+        # (named/attribute conds are resolved by pass-1 pred_marked)
+        pred = None
+        if base == "while_loop":
+            tgt = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "cond_fun":
+                    tgt = kw.value
+            if isinstance(tgt, ast.Lambda):
+                pred = tgt.body
+        # (c) lax.cond's predicate EXPRESSION (first positional arg)
+        elif base == "cond" and isinstance(node.func, ast.Attribute) \
+                and _attr_root(node.func) in ("lax", "jax"):
+            pred = node.args[0] if node.args else None
+        if pred is not None:
+            for sub in ast.walk(pred):
+                if isinstance(sub, ast.Call) \
+                        and self._is_collective_call(sub):
+                    self._sl108_emit(sub)
 
     # ------------------------------------------------------ SL102 branch
 
@@ -615,6 +697,8 @@ class _JitMarker(ast.NodeVisitor):
         self.marked: set[str] = set()
         # def name -> parameter names (SL107 resolves in-file callables)
         self.func_params: dict[str, tuple[str, ...]] = {}
+        # names passed as while_loop's cond_fun — predicate scope (SL108)
+        self.pred_marked: set[str] = set()
 
     def _visit_funcdef(self, node) -> None:
         a = node.args
@@ -626,6 +710,15 @@ class _JitMarker(ast.NodeVisitor):
     visit_AsyncFunctionDef = _visit_funcdef
 
     def visit_Call(self, node: ast.Call) -> None:
+        if _call_basename(node.func) == "while_loop":
+            tgt = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "cond_fun":
+                    tgt = kw.value
+            if isinstance(tgt, ast.Name):
+                self.pred_marked.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                self.pred_marked.add(tgt.attr)
         if _call_basename(node.func) in _JIT_WRAPPERS:
             for a in list(node.args) + [k.value for k in node.keywords]:
                 if isinstance(a, ast.Name):
@@ -664,6 +757,7 @@ def lint_source(src: str, path: str = "<string>") -> list[Finding]:
     linter = _Linter(path, src)
     linter.jit_marked = marker.marked
     linter.func_params = marker.func_params
+    linter.pred_marked = marker.pred_marked
     linter.visit(tree)
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
 

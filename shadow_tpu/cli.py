@@ -98,6 +98,14 @@ def make_parser() -> argparse.ArgumentParser:
                    help="arrange the mesh as M slices joined over DCN "
                         "(multi-slice; the reference's unfinished "
                         "multi-machine design, master.c:414-416)")
+    p.add_argument("--spmd", default="auto",
+                   choices=("auto", "shard_map", "constraint", "pmap"),
+                   help="SPMD execution path for sharded runs (see "
+                        "docs/12-Sharding.md): auto resolves to "
+                        "shard_map; constraint partitions a global "
+                        "program via jit sharding constraints; pmap is "
+                        "the legacy 1-D fallback kept for soak "
+                        "comparison")
     p.add_argument("--runahead", type=float, default=None,
                    help="override the conservative window width in "
                         "MILLISECONDS (options.c --runahead minTimeJump; "
@@ -412,7 +420,7 @@ def main(argv=None) -> int:
         return build_simulation(
             cfg, seed=args.seed, n_sockets=args.sockets,
             capacity=capacity,
-            mesh=mesh, tcp_cc=args.tcp_congestion_control,
+            mesh=mesh, spmd=args.spmd, tcp_cc=args.tcp_congestion_control,
             rx_queue=args.router_queue, qdisc=args.interface_qdisc,
             interface_buffer=args.interface_buffer, locality=args.locality,
             runahead_ns=(

@@ -170,6 +170,49 @@ class Stats:
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
+class ExchangeBuf:
+    """In-flight cross-shard events: the exchange double buffer.
+
+    `bucket` holds the [S, R] result of the LAST all_to_all round of the
+    previous flush — events destined for this shard that have been
+    exchanged but not yet merged into its queue. Delivery is deferred to
+    the next point the queue is actually read (the top of the next sweep
+    body, or the next window's open), so the shard-local drain of window
+    k overlaps the wire time of window k-1's exchange, and the window
+    barrier pmin never waits on an all_to_all completing.
+
+    `sent_min` is the min time of the events this shard SENT in that
+    deferred round (i64 max when none). The global pmin over per-shard
+    sent_min equals the global pmin over per-shard received mins — the
+    all_to_all only permutes the same [S, R] blocks — so `_next_time`
+    can fold the in-flight events into the barrier without a data
+    dependence on the collective's result.
+
+    Deferral is exact, not approximate: every delivery point sits in a
+    gap where no other queue operation runs (cond/flag evaluations only
+    read, and cross-window events are clamped >= the sending window's
+    end so they can never change a drain flag), and `queue_push` is
+    push-order-insensitive including its capacity drops — so the queue
+    trajectory, drops included, is bit-identical to immediate delivery
+    and therefore to the single-device run.
+    """
+
+    bucket: Events  # [S, R] received, undelivered cross-shard events
+    # i64[1], not a scalar: per-shard private state must shard on the
+    # mesh axis across the shard_map boundary (a scalar would be forced
+    # into a replicated P() out_spec, which this value is not)
+    sent_min: jax.Array  # i64[1] min time sent in the deferred round
+
+    @staticmethod
+    def create(n_shards: int, r: int, n_args: int = N_ARGS) -> "ExchangeBuf":
+        return ExchangeBuf(
+            bucket=Events.empty((n_shards, r), n_args=n_args),
+            sent_min=jnp.full((1,), TIME_INVALID, jnp.int64),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
 class EngineState:
     """Complete simulation state for one shard: a pure pytree.
 
@@ -194,6 +237,10 @@ class EngineState:
     # leaves, keeping the compiled program and checkpoint layout
     # identical to a trace-free build
     trace: Any = None
+    # in-flight cross-shard exchange buffer (ExchangeBuf) or None when
+    # unsharded — None contributes zero pytree leaves, so single-device
+    # programs and checkpoints are untouched by the sharded overlap
+    xchg: Any = None
 
 
 def state_summary(state: EngineState) -> dict:
@@ -462,6 +509,24 @@ class Engine:
                 "faults with crashes or bandwidth changes need a "
                 "fault_reset template (the initial hosts pytree)"
             )
+        # static all_to_all bucket width: ONE width for every exchange in
+        # the program, because the deferred recv bucket is carried state
+        # (ExchangeBuf) whose shape must agree across sweeps and across
+        # the narrow/wide flush branches. Sized off the widest flat batch
+        # either drain path pushes, with the same quarter-of-uniform
+        # default the per-call sizing used.
+        if cfg.axis_name is not None:
+            if batch_handler is not None:
+                m_ref = cfg.n_hosts * cfg.eff_drain_batch * cfg.max_emit
+            else:
+                m_ref = cfg.n_hosts * max(
+                    cfg.eff_stage_width, cfg.eff_drain_batch + cfg.max_emit
+                )
+            self._xchg_r = cfg.route_bucket or max(
+                16, -(-m_ref // cfg.n_shards) // 4
+            )
+        else:
+            self._xchg_r = 0
 
     # -- collectives (identity when unsharded) ------------------------------
     def _gmin(self, x):
@@ -479,7 +544,40 @@ class Engine:
             return jax.lax.psum(x, self.cfg.axis_name)
         return x
 
-    def _exchange_push(self, q: EventQueue, ev: Events, mask: jax.Array, host0):
+    def _drain_flag(self, q: EventQueue, cpu_free, window_end) -> jax.Array:
+        """True while any host (globally) still has an executable event
+        below the window barrier. Computed in loop BODIES and threaded
+        through the carry — never evaluated inside a while_loop cond —
+        so the lowered predicate contains no collective (the 0.4.37
+        experimental-shard_map miscompile leaks device 0's carry when a
+        collective sits inside a cond; see docs/12-Sharding.md)."""
+        nxt = q.min_time()
+        if self._cpu_enabled:
+            nxt = jnp.maximum(nxt, cpu_free)
+        return self._gany(jnp.any(nxt < window_end))
+
+    def _xchg_deliver(self, q: EventQueue, xchg, host0):
+        """Merge the in-flight exchange buffer into the local queue and
+        return it emptied. The guard predicate is shard-local and both
+        branches are collective-free, so per-shard divergence is safe
+        under shard_map; the common no-cross-traffic case skips the
+        queue merge entirely."""
+        if xchg is None:
+            return q, xchg
+        flat = xchg.bucket.flatten()
+        valid = flat.time != TIME_INVALID
+        q = jax.lax.cond(
+            jnp.any(valid),
+            lambda q: queue_push(q, flat, valid, host0, self.cfg.kernel),
+            lambda q: q,
+            q,
+        )
+        return q, ExchangeBuf.create(
+            self.cfg.n_shards, self._xchg_r, self.cfg.n_args
+        )
+
+    def _exchange_push(self, q: EventQueue, xchg, ev: Events,
+                       mask: jax.Array, host0):
         """Push a flat routed batch, delivering cross-shard events by
         bucketed all_to_all.
 
@@ -492,20 +590,28 @@ class Engine:
         rather than total packets (the TPU-native replacement for the
         reference's shared-memory scheduler_push across threads,
         scheduler.c:342-360; SURVEY.md §2.4).
+
+        Each round's received bucket is NOT pushed in that round: it
+        lands in `xchg` and is merged at the top of the NEXT round's
+        body — and the final round's recv rides out in the returned
+        ExchangeBuf to the next sweep or window (double buffering). The
+        loop predicate reads a carried flag; the psum deciding another
+        round runs in the body (see `_drain_flag`).
         """
         z = jnp.zeros((), jnp.int64)
         if self.cfg.axis_name is None:
-            return queue_push(q, ev, mask, host0, self.cfg.kernel), z, z
+            return queue_push(q, ev, mask, host0, self.cfg.kernel), xchg, z, z
         cfg = self.cfg
         ax = cfg.axis_name
         h, s = cfg.n_hosts, cfg.n_shards
         my = jax.lax.axis_index(ax).astype(jnp.int32)
         m = ev.time.shape[0]
-        # default bucket: a quarter of the uniform-traffic worst case —
-        # small enough that lightly-coupled shards don't pay Θ(batch) ICI
-        # traffic every iteration, large enough that uniform workloads
-        # rarely need a second round (overflow just loops, lossless)
-        r = cfg.route_bucket or max(16, -(-m // s) // 4)
+        # engine-level static bucket width (see __init__): a quarter of
+        # the widest uniform-traffic case — small enough that lightly-
+        # coupled shards don't pay Θ(batch) ICI traffic every iteration,
+        # large enough that uniform workloads rarely need a second round
+        # (overflow just loops, lossless)
+        r = self._xchg_r
 
         dshard = ev.dst // jnp.int32(h)
         in_range = (dshard >= 0) & (dshard < s)
@@ -516,11 +622,11 @@ class Engine:
         pos = jnp.arange(m, dtype=jnp.int32)
 
         def cond(carry):
-            rem = carry[1]
-            return jax.lax.psum(jnp.any(rem).astype(jnp.int32), ax) > 0
+            return carry[0]
 
         def body(carry):
-            q, rem, rounds = carry
+            _, q, xchg, rem, rounds = carry
+            q, xchg = self._xchg_deliver(q, xchg, host0)
             dkey = jnp.where(rem, dshard, s)
             order = jnp.argsort(dkey, stable=True)
             sd = dkey[order]
@@ -544,23 +650,27 @@ class Engine:
                 lambda x: jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0),
                 bucket,
             )
-            recv_flat = recv.flatten()
-            q2 = queue_push(
-                q, recv_flat, recv_flat.time != TIME_INVALID, host0,
-                cfg.kernel,
+            # min over what this shard SENT (pre-exchange) — globally
+            # pmin-equivalent to the receiver-side min, with no data
+            # dependence on the collective's result
+            xchg = ExchangeBuf(
+                bucket=recv, sent_min=jnp.min(bucket.time).reshape((1,))
             )
             sent = jnp.zeros((m,), bool).at[order].set(sel)
-            return q2, rem & ~sent, rounds + 1
+            rem = rem & ~sent
+            return self._gany(jnp.any(rem)), q, xchg, rem, rounds + 1
 
         # global count (each shard only sees its own outbound packets;
         # the replicated stats scalar needs the psum'd total)
         n_cross = jax.lax.psum(
             jnp.sum(remaining, dtype=jnp.int64), ax
         )
-        q, _, rounds = jax.lax.while_loop(
-            cond, body, (q, remaining, jnp.zeros((), jnp.int64))
+        _, q, xchg, _, rounds = jax.lax.while_loop(
+            cond, body,
+            (self._gany(jnp.any(remaining)), q, xchg, remaining,
+             jnp.zeros((), jnp.int64)),
         )
-        return q, rounds, n_cross
+        return q, xchg, rounds, n_cross
 
     # -- state construction -------------------------------------------------
     def _trace_slack(self) -> int:
@@ -598,6 +708,9 @@ class Engine:
             trace = TraceRing.create(
                 cfg.n_hosts, cfg.trace, self._trace_slack()
             )
+        xchg = None
+        if cfg.axis_name is not None:
+            xchg = ExchangeBuf.create(cfg.n_shards, self._xchg_r, cfg.n_args)
         return EngineState(
             now=jnp.zeros((), jnp.int64),
             queues=q,
@@ -608,6 +721,7 @@ class Engine:
             cpu_free=jnp.zeros((cfg.n_hosts,), jnp.int64),
             fault_epoch=jnp.zeros((), jnp.int32),
             trace=trace,
+            xchg=xchg,
         )
 
     # -- fault-schedule helpers ---------------------------------------------
@@ -827,14 +941,18 @@ class Engine:
         al_sh = self._alive_slice(host0) if self._f_crash else None
 
         def outer_cond(carry):
-            q, cpu_free = carry[0], carry[5]
-            nxt = q.min_time()
-            if self._cpu_enabled:
-                nxt = jnp.maximum(nxt, cpu_free)
-            return self._gany(jnp.any(nxt < window_end))
+            # carried flag: the psum/any deciding another sweep runs at
+            # the END of the body (`_drain_flag`), never in this cond —
+            # collective-free predicates are what keep the sharded
+            # lowering correct on jax 0.4.37 (see docs/12-Sharding.md)
+            return carry[0]
 
         def outer_body(carry):
-            q, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            _, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            # merge window k-1's in-flight exchange before reading the
+            # frontier: the gap since the sending sweep's push contains
+            # no queue operation, so deferred delivery is bit-identical
+            q, xchg = self._xchg_deliver(q, xchg, host0)
             bt = q.time[:, :b]
             # a host whose virtual CPU is busy past the barrier runs
             # nothing this window (whole-frontier granularity)
@@ -975,8 +1093,8 @@ class Engine:
             q = dataclasses.replace(
                 q, time=jnp.where(cleared, TIME_INVALID, q.time)
             )
-            q, xr, nc = self._exchange_push(
-                q, out.flatten(), final_mask.reshape(-1), host0
+            q, xchg, xr, nc = self._exchange_push(
+                q, xchg, out.flatten(), final_mask.reshape(-1), host0
             )
             stats2 = dataclasses.replace(
                 stats2,
@@ -984,12 +1102,20 @@ class Engine:
                 n_xchg_rounds=stats2.n_xchg_rounds + xr,
                 n_cross_shard=stats2.n_cross_shard + nc,
             )
-            return (q, hosts, src_seq, exec_cnt, stats2, cpu_free, trace)
+            more = self._drain_flag(q, cpu_free, window_end)
+            return (more, q, xchg, hosts, src_seq, exec_cnt, stats2,
+                    cpu_free, trace)
 
-        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats,
-                 st.cpu_free, st.trace)
-        (q, hosts, src_seq, exec_cnt, stats, cpu_free,
+        carry = (self._drain_flag(st.queues, st.cpu_free, window_end),
+                 st.queues, st.xchg, st.hosts, st.src_seq, st.exec_cnt,
+                 st.stats, st.cpu_free, st.trace)
+        (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free,
          trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
+        if self._cpu_enabled:
+            # the barrier's sent_min shortcut cannot see a destination
+            # host's busy CPU; flush in-flight events before `_next_time`
+            # runs so the max(min_time, cpu_free) defer stays exact
+            q, xchg = self._xchg_deliver(q, xchg, host0)
         return dataclasses.replace(
             st,
             queues=q,
@@ -999,6 +1125,7 @@ class Engine:
             stats=dataclasses.replace(stats, n_windows=stats.n_windows + 1),
             cpu_free=cpu_free,
             trace=trace,
+            xchg=xchg,
         )
 
     # -- staging-buffer helpers (chained drain) ------------------------------
@@ -1201,16 +1328,19 @@ class Engine:
         al_sh = self._alive_slice(host0) if self._f_crash else None
 
         def outer_cond(carry):
-            q, cpu_free = carry[0], carry[5]
-            # a host's next executable instant is its earliest event or,
-            # if later, when its virtual CPU frees up (cpu.c semantics)
-            nxt = q.min_time()
-            if self._cpu_enabled:
-                nxt = jnp.maximum(nxt, cpu_free)
-            return self._gany(jnp.any(nxt < window_end))
+            # carried flag (computed by `_drain_flag` in the body): a
+            # host's next executable instant is its earliest event or,
+            # if later, when its virtual CPU frees up (cpu.c semantics).
+            # The psum lives in the body, never in this predicate — the
+            # structural rule that keeps 0.4.37 shard_map correct
+            return carry[0]
 
         def outer_body(carry):
-            q, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            _, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            # merge the previous sweep's in-flight exchange before the
+            # frontier read: no queue op ran since its sending push, so
+            # the deferred merge is bit-identical to an immediate one
+            q, xchg = self._xchg_deliver(q, xchg, host0)
 
             # 1. move the frontier into staging: queue rows are sorted by
             # (time, src, seq) with empties last (events.py invariant), so
@@ -1391,33 +1521,35 @@ class Engine:
             )
 
             def push_narrow(args):
-                q, stage = args
+                q, xchg, stage = args
                 sl = jax.tree.map(lambda a: a[:, :w1], stage)
                 flat = sl.flatten()
                 return self._exchange_push(
-                    q, flat, flat.time != TIME_INVALID, host0
+                    q, xchg, flat, flat.time != TIME_INVALID, host0
                 )
 
             def push_full(args):
-                q, stage = args
+                q, xchg, stage = args
                 flat = stage.flatten()
                 return self._exchange_push(
-                    q, flat, flat.time != TIME_INVALID, host0
+                    q, xchg, flat, flat.time != TIME_INVALID, host0
                 )
 
             if w1 == sw:
-                q, xr, nc = push_full((q, stage))
+                q, xchg, xr, nc = push_full((q, xchg, stage))
             elif cfg.axis_name is not None:
                 # sharded: the exchange's collectives must run under a
                 # shard-uniform program, and maxcnt differs per shard —
-                # make the branch choice global
+                # make the branch choice global. The ExchangeBuf's one
+                # static engine-level width is what lets both branches
+                # return the same carried-buffer shape.
                 go_wide = self._gany(maxcnt > w1)
-                q, xr, nc = jax.lax.cond(
-                    go_wide, push_full, push_narrow, (q, stage)
+                q, xchg, xr, nc = jax.lax.cond(
+                    go_wide, push_full, push_narrow, (q, xchg, stage)
                 )
             else:
-                q, xr, nc = jax.lax.cond(
-                    maxcnt > w1, push_full, push_narrow, (q, stage)
+                q, xchg, xr, nc = jax.lax.cond(
+                    maxcnt > w1, push_full, push_narrow, (q, xchg, stage)
                 )
             stats = dataclasses.replace(
                 stats,
@@ -1425,12 +1557,19 @@ class Engine:
                 n_xchg_rounds=stats.n_xchg_rounds + xr,
                 n_cross_shard=stats.n_cross_shard + nc,
             )
-            return (q, hosts, src_seq, exec_cnt, stats, cpu_free, trace)
+            more = self._drain_flag(q, cpu_free, window_end)
+            return (more, q, xchg, hosts, src_seq, exec_cnt, stats,
+                    cpu_free, trace)
 
-        carry = (st.queues, st.hosts, st.src_seq, st.exec_cnt, st.stats,
-                 st.cpu_free, st.trace)
-        (q, hosts, src_seq, exec_cnt, stats, cpu_free,
+        carry = (self._drain_flag(st.queues, st.cpu_free, window_end),
+                 st.queues, st.xchg, st.hosts, st.src_seq, st.exec_cnt,
+                 st.stats, st.cpu_free, st.trace)
+        (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free,
          trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
+        if self._cpu_enabled:
+            # sent_min cannot see a destination's busy CPU: flush the
+            # in-flight buffer before `_next_time`'s cpu_free defer runs
+            q, xchg = self._xchg_deliver(q, xchg, host0)
         # each shard's inner loop trips independently; fold this window's
         # delta across shards so the counter stays replicated-consistent
         inner = st.stats.n_inner_steps + self._gsum(
@@ -1447,16 +1586,26 @@ class Engine:
             ),
             cpu_free=cpu_free,
             trace=trace,
+            xchg=xchg,
         )
 
     def _next_time(self, st: EngineState) -> jax.Array:
         """Global earliest executable time (one reduction + one pmin):
         per host the earliest pending event, deferred to when its virtual
-        CPU frees up (empty queues stay at TIME_INVALID = i64 max)."""
+        CPU frees up (empty queues stay at TIME_INVALID = i64 max).
+
+        Sharded, the barrier also folds in `xchg.sent_min` — the min
+        time of events still in flight in the exchange double buffer —
+        through the SENDER-side copy, so the pmin never carries a data
+        dependence on an all_to_all completing (ExchangeBuf docstring).
+        """
         nxt = st.queues.min_time()
         if self._cpu_enabled:
             nxt = jnp.maximum(nxt, st.cpu_free)
-        return self._gmin(jnp.min(nxt))
+        m = jnp.min(nxt)
+        if st.xchg is not None:
+            m = jnp.minimum(m, st.xchg.sent_min[0])
+        return self._gmin(m)
 
     def _apply_fault_epoch(self, st: EngineState, nxt, host0) -> EngineState:
         """Apply fault-schedule transitions entered since the last window.
@@ -1550,6 +1699,13 @@ class Engine:
         if window is None:
             window = self.cfg.lookahead
         window_end = jnp.minimum(nxt + window, stop)
+        if st.xchg is not None:
+            # open of window k: merge window k-1's in-flight exchange.
+            # Must precede the fault-epoch wipe (an immediate push would
+            # have) and the drain's initial flag, whose barrier these
+            # events may now be below.
+            q, xchg = self._xchg_deliver(st.queues, st.xchg, host0)
+            st = dataclasses.replace(st, queues=q, xchg=xchg)
         if self._f_crash or self._f_bw:
             st = self._apply_fault_epoch(st, nxt, host0)
         st = self._drain_window(st, window_end, host0)
@@ -1568,8 +1724,11 @@ class Engine:
 
         def done(st):
             # no event below stop remains: land on stop so callers looping
-            # "while now < stop: step_window" terminate
-            return dataclasses.replace(st, now=stop)
+            # "while now < stop: step_window" terminate. Flush any
+            # in-flight exchange so the final queues match a run whose
+            # deliveries were immediate (i.e. the single-device run).
+            q, xchg = self._xchg_deliver(st.queues, st.xchg, host0)
+            return dataclasses.replace(st, queues=q, xchg=xchg, now=stop)
 
         return jax.lax.cond(
             nxt < stop,
@@ -1600,6 +1759,12 @@ class Engine:
             return st, self._next_time(st)
 
         st, _ = jax.lax.while_loop(cond, body, (st, self._next_time(st)))
+        if st.xchg is not None:
+            # flush the last window's in-flight exchange: every remaining
+            # event is >= stop, but it must sit in the queues (not the
+            # double buffer) for the final state to match single-device
+            q, xchg = self._xchg_deliver(st.queues, st.xchg, host0)
+            st = dataclasses.replace(st, queues=q, xchg=xchg)
         return dataclasses.replace(st, now=stop)
 
 

@@ -40,6 +40,39 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
                check_rep=check_vma)
 
 
+def probe_spmd() -> str:
+    """Which shard_map this jax ships: "shard_map" (public `jax.shard_map`)
+    or "shard_map_exp" (`jax.experimental.shard_map`, every release back
+    to 0.4.x). Both are safe for this engine: the experimental one's
+    check_rep=False miscompile only fires when a collective sits inside a
+    while/cond predicate, and core.engine carries every such flag through
+    the loop body instead (the SL108 rule pins this structurally). The
+    probe exists so path selection and error messages can name what the
+    running jax actually supports."""
+    if hasattr(jax, "shard_map"):
+        return "shard_map"
+    try:
+        from jax.experimental.shard_map import shard_map as _sm  # noqa: F401
+        return "shard_map_exp"
+    except ImportError:  # pragma: no cover - ancient jax
+        return "pmap"
+
+
+def select_spmd(spmd: str = "auto") -> str:
+    """Resolve an --spmd request to the executed path: "shard_map",
+    "constraint" (jit + explicit shardings, GSPMD partitioning), or
+    "pmap" (the legacy 1-D fallback). "auto" takes shard_map whenever
+    the probe finds one (public or experimental) and only falls back to
+    pmap on a jax with neither."""
+    if spmd not in ("auto", "shard_map", "constraint", "pmap"):
+        raise ValueError(
+            f"spmd must be auto|shard_map|constraint|pmap, got {spmd!r}"
+        )
+    if spmd == "auto":
+        return "shard_map" if probe_spmd() != "pmap" else "pmap"
+    return spmd
+
+
 def make_mesh(n_devices: int | None = None, axis: str = HOSTS_AXIS,
               dcn_slices: int = 1) -> Mesh:
     devs = jax.devices()
@@ -72,14 +105,28 @@ def hosts_axes(mesh: Mesh):
 
 def state_specs(st, n_hosts_local: int, axis: str = HOSTS_AXIS):
     """PartitionSpec pytree for an EngineState: leaves with a leading
-    per-shard host dim shard on `axis`; scalars (now, n_windows) replicate."""
+    per-shard host dim shard on `axis`; scalars (now, n_windows) replicate.
+    The exchange double buffer (EngineState.xchg) is per-shard PRIVATE
+    state — its leaves shard on `axis` unconditionally, never replicate,
+    whatever their leading dim happens to equal."""
 
     def spec(leaf):
         if leaf.ndim >= 1 and leaf.shape[0] == n_hosts_local:
             return P(axis)
         return P()
 
-    return jax.tree.map(spec, st)
+    specs = jax.tree.map(spec, st)
+    xchg = getattr(st, "xchg", None)
+    if xchg is not None:
+        import dataclasses as _dc
+
+        specs = _dc.replace(
+            specs,
+            xchg=jax.tree.map(
+                lambda leaf: P(axis) if leaf.ndim >= 1 else P(), xchg
+            ),
+        )
+    return specs
 
 
 def pmap_call(fn, mesh: Mesh, specs, per: int, axes):
@@ -92,13 +139,20 @@ def pmap_call(fn, mesh: Mesh, specs, per: int, axes):
     the mature pmap path compiles the identical program correctly.
 
     `specs` is the state's PartitionSpec pytree: leaves sharded on the
-    host axis reshape [S*per, ...] <-> [S, per, ...] around the pmap;
-    replicated leaves broadcast in and take device 0's copy out (the
-    same contract shard_map's P() out_spec has).
+    mesh axis reshape [S*d0, ...] <-> [S, d0, ...] around the pmap
+    (d0 = leading dim / S: host-dim leaves use `per`, the exchange
+    buffer its own width); replicated leaves broadcast in and take
+    device 0's copy out (the same contract shard_map's P() out_spec
+    has).
     """
     if not isinstance(axes, str):
         raise NotImplementedError(
-            "multi-slice meshes need jax.shard_map (jax >= 0.4.38)"
+            "the pmap fallback is single-axis only: a multi-slice "
+            "(dcn x hosts) mesh must run through the SPMD paths — this "
+            f"jax's capability probe says {probe_spmd()!r}, so build "
+            "with spmd='auto' (selects "
+            f"{select_spmd('auto')!r}) or spmd='constraint' instead of "
+            "spmd='pmap'"
         )
     n = int(np.prod(mesh.devices.shape))
     mask = jax.tree.map(lambda sp: len(sp) > 0, specs)
@@ -106,13 +160,15 @@ def pmap_call(fn, mesh: Mesh, specs, per: int, axes):
 
     def split(st):
         return jax.tree.map(
-            lambda x, m: x.reshape((n, per) + x.shape[1:]) if m else x,
+            lambda x, m: x.reshape((n, x.shape[0] // n) + x.shape[1:])
+            if m else x,
             st, mask,
         )
 
     def join(st):
         return jax.tree.map(
-            lambda x, m: x.reshape((n * per,) + x.shape[2:]) if m else x,
+            lambda x, m: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+            if m else x,
             st, mask,
         )
 
@@ -132,13 +188,27 @@ def pmap_call(fn, mesh: Mesh, specs, per: int, axes):
     return call
 
 
-def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int, axis: str = HOSTS_AXIS):
+def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int,
+                  axis: str = HOSTS_AXIS, spmd: str = "auto"):
     """Wrap an axis-aware Engine into sharded init/run/step callables.
 
     `eng` must have been built with axis_name=axis and per-shard host count
     n_hosts_local. Returns (init, run, step_window), all jitted over `mesh`:
     init() -> sharded EngineState; run(st, stop) / step_window(st, stop).
+
+    `spmd` picks the execution path (see `select_spmd`): "auto" resolves
+    to shard_map — public or experimental, both safe now that the engine
+    carries every loop flag through the body (no collective ever sits in
+    a lowered predicate) — and "pmap" keeps the legacy 1-D fallback
+    alive for soak comparison.
     """
+    path = select_spmd(spmd)
+    if path == "constraint":
+        raise ValueError(
+            "spmd='constraint' partitions a GLOBAL (axis_name=None) "
+            "engine with GSPMD and cannot wrap this per-shard engine; "
+            "build it via sim.build_simulation(..., spmd='constraint')"
+        )
 
     def _host0():
         return jax.lax.axis_index(axis).astype(jnp.int32) * n_hosts_local
@@ -157,8 +227,12 @@ def build_sharded(eng, init_fn, mesh: Mesh, n_hosts_local: int, axis: str = HOST
     )
 
     def _wrap(fn):
-        if not hasattr(jax, "shard_map"):
+        if path == "pmap":
             return pmap_call(fn, mesh, specs, n_hosts_local, axis)
+        # no donate_argnums here: this is the raw API and callers (tests,
+        # smoke entries) legitimately reread their input state after the
+        # call. The managed path (sim.Simulation) donates — it tracks
+        # state ownership and can prove the input buffer is dead.
         return jax.jit(
             shard_map(
                 lambda s, t: fn(s, t, _host0()),

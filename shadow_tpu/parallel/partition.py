@@ -50,16 +50,27 @@ def traffic_edges_from_config(hosts) -> list[tuple[int, int, int]]:
 
 
 def locality_order(
-    n_hosts: int, edges: list[tuple[int, int, int]], n_shards: int
+    n_hosts: int, edges: list[tuple[int, int, int]], n_shards: int,
+    dcn_slices: int = 1,
 ) -> list[int]:
     """Permutation `perm` such that placing host perm[i] at position i
     block-partitions chatty clusters onto common shards.
 
     Every shard receives exactly n_hosts // n_shards hosts (the engine's
     block partition requires equal shards).
+
+    `dcn_slices` (multi-slice meshes): shards group dcn-major into
+    slices of n_shards // dcn_slices — the same layout the mesh's
+    block partition uses — and a cluster too large for one shard
+    splits across the shards of ONE slice when any slice has the room,
+    so its internal traffic rides ICI instead of DCN.
     """
     if n_hosts % n_shards:
         raise ValueError(f"{n_hosts} hosts not divisible by {n_shards}")
+    if dcn_slices > 1 and n_shards % dcn_slices:
+        raise ValueError(
+            f"{n_shards} shards not divisible by {dcn_slices} DCN slices"
+        )
     cap = n_hosts // n_shards
 
     parent = list(range(n_hosts))
@@ -97,9 +108,32 @@ def locality_order(
                 placed = True
                 break
         if not placed:
-            # split the cluster across the emptiest shards (only happens
-            # when remaining free space is fragmented)
+            # split the cluster across shards (only happens when the
+            # remaining free space is fragmented). On a multi-slice
+            # mesh the WHOLE cluster prefers the roomiest single slice
+            # before spilling to the next, so its internal traffic
+            # rides ICI rather than DCN; slice order is fixed per
+            # cluster, not re-chosen per chunk.
             rest = list(members)
+            if dcn_slices > 1:
+                per_slice = n_shards // dcn_slices
+
+                def _free(sl: int) -> int:
+                    return sum(cap - len(s) for s in
+                               shards[sl * per_slice:(sl + 1) * per_slice])
+
+                order = [
+                    sl * per_slice + k
+                    for sl in sorted(range(dcn_slices),
+                                     key=lambda i: (-_free(i), i))
+                    for k in range(per_slice)
+                ]
+                for idx in order:
+                    if not rest:
+                        break
+                    take = min(cap - len(shards[idx]), len(rest))
+                    shards[idx].extend(rest[:take])
+                    rest = rest[take:]
             while rest:
                 s = min(shards, key=len)
                 take = min(cap - len(s), len(rest))

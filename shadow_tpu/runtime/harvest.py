@@ -119,12 +119,13 @@ class HeartbeatHarvest:
             return state, bundle
 
         # donation mirrors Simulation._wrap's gate: single-device jits
-        # donate; sharded states keep plain jit (GSPMD propagates the
-        # shardings through the reductions), and the pmap fallback's
-        # stacked outputs go through undonated too
-        if sim.mesh is None:
+        # and the SPMD paths (shard_map / constraint — their states are
+        # ordinary sharded jit arrays, safe to donate through the
+        # pass-through) donate; only the pmap fallback's stacked outputs
+        # go through undonated
+        if sim.mesh is None or sim.spmd_path != "pmap":
             return jax.jit(extract, donate_argnums=0)
-        return jax.jit(extract)  # shadowlint: no-donate=sharded/pmap-fallback states; mirrors Simulation._wrap's donation gate
+        return jax.jit(extract)  # shadowlint: no-donate=pmap-fallback stacked states; mirrors Simulation._wrap's donation gate
 
     def extract(self, state, *, full: bool):
         """Queue the extraction behind whatever is in flight; returns

@@ -135,6 +135,13 @@ class Simulation:
     app: Any  # the AppModel instance
     stack: Stack
     mesh: Any = None  # jax.sharding.Mesh when sharded
+    # requested SPMD lowering for the sharded paths: "auto" resolves via
+    # parallel.mesh.select_spmd (shard_map on every supported jax;
+    # "constraint" = jit + explicit NamedShardings over a GLOBAL engine,
+    # GSPMD inserts the collectives; "pmap" = the legacy 1-D fallback,
+    # kept alive for soak comparison). See `spmd_path` for the resolved
+    # value and docs/12-Sharding.md for the selection matrix.
+    spmd: str = "auto"
     pcap_gids: tuple = ()  # hosts with logpcap set
     pcap_dir: str = "shadow.pcap.d"  # from the pcapdir host attr
     kind_names: tuple = ()  # handler-kind names (object-counter labels)
@@ -159,8 +166,20 @@ class Simulation:
     _jit_step_w: Any = None  # traced-window variant (--window auto)
     _owned: Any = None  # weak id-map of donation-safe states we produced
 
+    @property
+    def spmd_path(self) -> str | None:
+        """The EXECUTED sharding path: None (single device), "shard_map",
+        "constraint", or "pmap". This is what tests assert on — no
+        jax.pmap runs unless this says so."""
+        if self.mesh is None:
+            return None
+        from shadow_tpu.parallel.mesh import select_spmd
+
+        return select_spmd(self.spmd)
+
     def _wrap(self, fn):
-        """Jit `fn(state, stop, host0)`, under shard_map when sharded.
+        """Jit `fn(state, stop, host0)`, under the selected SPMD path
+        when sharded.
 
         The state argument is DONATED: the [H, C] queue arrays, staging
         buffers, and trace/spill rings alias the outputs instead of
@@ -188,8 +207,9 @@ class Simulation:
         specs = state_specs(
             self.state0, per * self.engine.cfg.n_shards, axes
         )
+        path = self.spmd_path
 
-        if not hasattr(jax, "shard_map"):
+        if path == "pmap":
             from shadow_tpu.parallel.mesh import pmap_call
 
             # no donation on the pmap fallback: jax.pmap's donation is
@@ -197,6 +217,29 @@ class Simulation:
             # reshape/stack plumbing on old jax pins; the fallback is a
             # compatibility path, not the perf path
             return pmap_call(fn, self.mesh, specs, per, axes)
+
+        if path == "constraint":
+            # GSPMD path: the engine is GLOBAL (axis_name=None — it runs
+            # no manual collectives), the state is pinned to the mesh by
+            # explicit NamedShardings, and the partitioner inserts the
+            # cross-device movement. Bit-identity with single-device is
+            # structural: this IS the single-device program.
+            from jax.sharding import NamedSharding
+
+            shardings = jax.tree.map(
+                lambda sp: NamedSharding(self.mesh, sp), specs
+            )
+
+            def constrained(st, stop):
+                st = jax.lax.with_sharding_constraint(st, shardings)
+                return fn(st, stop, 0)
+
+            return jax.jit(
+                constrained,
+                in_shardings=(shardings, None),
+                out_shardings=shardings,
+                donate_argnums=0,
+            )
 
         def sharded(st, stop):
             host0 = jax.lax.axis_index(axes).astype(jnp.int32) * per
@@ -388,11 +431,11 @@ class Simulation:
         """Build the traced-window step jit once (--window N / auto)."""
         if self._jit_step_w is not None:
             return
-        if self.mesh is not None and not hasattr(jax, "shard_map"):
+        if self.spmd_path == "pmap":
             raise ValueError(
-                "adaptive windows (--window auto) need the "
-                "shard_map path; the pmap fallback runs fixed "
-                "windows only"
+                "adaptive windows (--window auto) need the shard_map or "
+                "constraint SPMD path; the pmap fallback runs fixed "
+                "windows only (selected spmd='pmap')"
             )
         if self.mesh is None:
             jsw = jax.jit(
@@ -418,6 +461,24 @@ class Simulation:
         specs = state_specs(
             self.state0, per * self.engine.cfg.n_shards, axes
         )
+
+        if self.spmd_path == "constraint":
+            from jax.sharding import NamedSharding
+
+            shardings = jax.tree.map(
+                lambda sp: NamedSharding(self.mesh, sp), specs
+            )
+
+            def constrained(st, stop, w):
+                st = jax.lax.with_sharding_constraint(st, shardings)
+                return self.engine.step_window(st, stop, 0, window=w)
+
+            return jax.jit(
+                constrained,
+                in_shardings=(shardings, None, None),
+                out_shardings=shardings,
+                donate_argnums=0,
+            )
 
         def sharded(st, stop, w):
             host0 = jax.lax.axis_index(axes).astype(jnp.int32) * per
@@ -658,8 +719,16 @@ def build_simulation(
     profiler: Any = None,
     overflow: str = "drop",
     spill_len: int = 0,
+    spmd: str = "auto",
 ) -> Simulation:
-    """Config -> Simulation; pass a 1-D `jax.sharding.Mesh` to shard hosts.
+    """Config -> Simulation; pass a `jax.sharding.Mesh` (1-D "hosts" or
+    2-D "dcn" x "hosts") to shard hosts.
+
+    `spmd` selects the sharded lowering: "auto" resolves to shard_map
+    (public or experimental — the engine's collective-free loop
+    predicates make both safe), "constraint" builds ONE global engine
+    and lets GSPMD partition it from explicit NamedShardings, "pmap"
+    keeps the legacy 1-D fallback. See docs/12-Sharding.md.
 
     `locality=True` (sharded runs only) reorders hosts at build time so
     config-visible traffic partners share a shard, cutting cross-shard
@@ -703,7 +772,11 @@ def build_simulation(
         )
 
         edges = traffic_edges_from_config(hosts)
-        perm = locality_order(n_hosts, edges, int(mesh.devices.size))
+        perm = locality_order(
+            n_hosts, edges, int(mesh.devices.size),
+            dcn_slices=(mesh.devices.shape[0]
+                        if mesh.devices.ndim == 2 else 1),
+        )
         hosts = apply_order(hosts, perm)
 
     # -- shape bucketing: pad the host dimension to a standard ladder so
@@ -987,9 +1060,11 @@ def build_simulation(
         lookahead = runahead_ns
     else:
         lookahead = max(int(topo.min_latency_ms * MILLISECOND), 1)
+    spmd_path = None
     if mesh is not None:
-        from shadow_tpu.parallel.mesh import hosts_axes
+        from shadow_tpu.parallel.mesh import hosts_axes, select_spmd
 
+        spmd_path = select_spmd(spmd)
         n_shards = int(mesh.devices.size)
         if n_hosts % n_shards:
             raise ValueError(
@@ -997,6 +1072,11 @@ def build_simulation(
             )
         per_shard = n_hosts // n_shards
         axis_name = hosts_axes(mesh)
+        if spmd_path == "constraint":
+            # GSPMD partitions ONE global program: the engine runs no
+            # manual collectives (axis_name=None), sees every host, and
+            # the mesh enters only through _wrap's NamedShardings
+            n_shards, per_shard, axis_name = 1, n_hosts, None
     else:
         n_shards, per_shard, axis_name = 1, n_hosts, None
     # burst delivery (engine._burst_fold): contiguous same-flow TCP
@@ -1138,7 +1218,9 @@ def build_simulation(
         kind=jnp.asarray(kinds), args=jnp.asarray(argw),
     )
 
-    if mesh is None:
+    if mesh is None or spmd_path == "constraint":
+        # constraint path: the global init IS the single-device init;
+        # _wrap's in_shardings spread it over the mesh on first call
         st0 = eng.init_state(hosts_state, init)
     else:
         # build the initial state under shard_map: each shard slices its
@@ -1192,7 +1274,7 @@ def build_simulation(
     return Simulation(
         engine=eng, state0=st0, stop_ns=int(cfg.stoptime * SECOND),
         dns=dns, topo=topo, names=[h.name for h in hosts], app=model,
-        stack=stack, mesh=mesh,
+        stack=stack, mesh=mesh, spmd=spmd,
         pcap_gids=tuple(int(g) for g in np.nonzero(pcap_mask)[0]),
         pcap_dir=(pcap_dirs.pop() if pcap_dirs else "shadow.pcap.d"),
         kind_names=tuple(kind_names),

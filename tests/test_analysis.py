@@ -246,6 +246,70 @@ def test_sl107_no_donate_exemption_needs_reason():
     assert _rules(fs) == ["SL107"]
 
 
+def test_sl108_collective_in_named_cond_fun():
+    # named cond function resolved by the pass-1 predicate marking —
+    # the exact shape of the PR-1 miscompile
+    fs = _lint("""
+        import jax
+        from jax import lax
+        def drain(q, stop, ax):
+            def cond(carry):
+                return lax.psum(carry[1], ax) > 0
+            def body(carry):
+                return carry
+            return lax.while_loop(cond, body, (q, stop))
+    """)
+    assert _rules(fs) == ["SL108"]
+
+
+def test_sl108_collective_in_lambda_cond_and_cond_pred():
+    fs = _lint("""
+        import jax
+        from jax import lax
+        def f(x, ax):
+            y = lax.while_loop(
+                lambda c: lax.pmin(c, ax) < 9, lambda c: c + 1, x)
+            return jax.lax.cond(
+                lax.psum(y, ax) > 0, lambda v: v, lambda v: -v, y)
+    """)
+    # one finding per collective: the pmin in the while's lambda cond
+    # AND the psum in lax.cond's predicate expression
+    assert [f.rule for f in fs] == ["SL108", "SL108"]
+
+
+def test_sl108_wrapper_and_method_cond():
+    # the engine's reduction wrappers count, and attribute conds
+    # (self._more) resolve through pass-1 marking too
+    fs = _lint("""
+        import jax
+        class Eng:
+            def _more(self, carry):
+                return self._gany(carry[0])
+            def loop(self, st):
+                return jax.lax.while_loop(
+                    self._more, lambda c: c, (st, 0))
+    """)
+    assert _rules(fs) == ["SL108"]
+
+
+def test_sl108_carried_flag_clean():
+    # the restructured engine shape: flag computed in the BODY,
+    # predicate only reads the carry — no finding
+    fs = _lint("""
+        import jax
+        from jax import lax
+        def drain(q, stop, ax):
+            def cond(carry):
+                return carry[0]
+            def body(carry):
+                flag, q = carry
+                return lax.psum(flag, ax) > 0, q
+            return lax.while_loop(
+                cond, body, (lax.psum(q, ax) > 0, q))
+    """)
+    assert fs == []
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng

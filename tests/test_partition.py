@@ -77,6 +77,24 @@ def test_edges_and_order_group_pairs():
             assert all(p in block for p in peer)
 
 
+def test_split_cluster_prefers_one_dcn_slice():
+    """A cluster that must split (fragmented free space) lands within
+    a single slice on a 2-slice mesh — its internal traffic then rides
+    ICI, not DCN — while every shard still ends exactly full."""
+    # 12 hosts, 4 shards of cap=3, 2 slices of 2 shards. Six pair
+    # clusters of 2: after four shards each take one pair (2/3 full),
+    # the last two pairs fit NO shard whole and take the split path.
+    pairs = [(2 * i, 2 * i + 1, 5) for i in range(6)]
+    perm = locality_order(12, pairs, 4, dcn_slices=2)
+    assert sorted(perm) == list(range(12))
+    half = len(perm) // 2  # slice 0 owns positions 0..5 (dcn-major)
+    for a, b, _ in pairs:
+        pa, pb = perm.index(a), perm.index(b)
+        assert (pa < half) == (pb < half), (a, b, perm)
+    # equal shards of exactly cap distinct hosts
+    assert all(len(set(perm[i:i + 3])) == 3 for i in range(0, 12, 3))
+
+
 def test_locality_halves_cross_shard_packets():
     mesh = make_mesh(8)
     # 8 pairs over 8 shards: smallest shape where naive interleaving
