@@ -654,6 +654,214 @@ def multichip_worker():
     _stamp(f"multichip results -> {path}")
 
 
+# 16-host PHOLD through a single 50ms self-edge: small enough that every
+# chaos attempt (compile included, warm cache) fits the smoke budget,
+# busy enough that every window carries cross-shard traffic on an
+# 8-shard mesh — the shape the reshard-on-resume path must survive.
+CHAOS_CFG = """<shadow stoptime="10">
+  <topology>
+    <![CDATA[<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+      <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+      <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+      <graph edgedefault="undirected">
+        <node id="poi-1">
+          <data key="d1">2048</data>
+          <data key="d2">2048</data>
+        </node>
+        <edge source="poi-1" target="poi-1">
+          <data key="d3">50.0</data>
+        </edge>
+      </graph>
+    </graphml>]]>
+  </topology>
+  <plugin id="phold" path="shadow-plugin-test-phold.so" />
+  <host id="peer" quantity="16">
+    <process plugin="phold" starttime="1" arguments="basename=peer quantity=16 load=4" />
+  </host>
+</shadow>
+"""
+
+# the summary keys that must be bit-identical across a recovery; wall
+# times and cross_shard_packets (mesh-dependent telemetry) are excluded
+CHAOS_CMP_KEYS = ("events", "windows", "net_dropped", "queue_drops",
+                  "fault_dropped", "quarantined_events", "sweeps",
+                  "rx_bytes", "tx_bytes", "events_by_kind")
+
+
+def chaos_worker():
+    """Chaos acceptance for the elastic-recovery subsystem
+    (measure_all.sh chaos_smoke stage, docs/13-Elastic-Recovery.md).
+
+    Two scenarios on a forced 8-device CPU mesh, both wrapped in
+    `runtime.supervisor.run_with_retry` and both asserted bit-identical
+    to an unsharded baseline of the same config:
+
+      1. preemption — SIGKILL the worker right after its first
+         checkpoint lands; the relaunch resumes on the same mesh;
+      2. peer loss — SHADOW_TPU_CHAOS_HANG_S wedges a harvest fetch
+         past --collective-timeout, the collective watchdog exits 77
+         with a per-shard bundle, and the relaunch resumes on a HALVED
+         mesh (8 -> 4) from the same checkpoint.
+
+    Reports mc_chaos_* (recoveries, MTTR, exit history, bit-identity)
+    and merges them into the newest MULTICHIP_r*.json so the multichip
+    record carries the recovery numbers next to the scaling numbers."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        _REPO, ".jax_cache_cpu")
+    import glob
+    import re as _re
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from shadow_tpu.runtime.supervisor import EXIT_PEER_LOST, run_with_retry
+
+    work = tempfile.mkdtemp(prefix="shadow_tpu_chaos_")
+    cfg = os.path.join(work, "cfg.xml")
+    with open(cfg, "w") as f:
+        f.write(CHAOS_CFG)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base_argv = [sys.executable, "-m", "shadow_tpu", "--overflow", "drop",
+                 "--seed", "1", cfg]
+
+    def _last_json(path: str) -> dict:
+        try:
+            with open(path) as f:
+                lines = f.read().strip().splitlines()
+        except OSError:
+            return {}
+        for line in reversed(lines):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        return {}
+
+    def _sig(summary: dict) -> dict:
+        return {k: summary.get(k) for k in CHAOS_CMP_KEYS}
+
+    def _retry_run(tag: str, extra_argv: list, *, hang_s: float = 0.0,
+                   on_spawn=None) -> tuple[dict, dict]:
+        """One run_with_retry supervision with child stdout routed to a
+        file (the worker's own stdout carries only the JSON protocol);
+        returns (report, final summary signature)."""
+        stdout_path = os.path.join(work, f"{tag}.out")
+        env2 = dict(env)
+        if hang_s > 0:
+            env2["SHADOW_TPU_CHAOS_HANG_S"] = str(hang_s)
+        with open(stdout_path, "ab") as out_f:
+            report = run_with_retry(
+                base_argv + extra_argv, retries=2, backoff_s=0.2,
+                on_spawn=on_spawn,
+                _popen=lambda a, **kw: subprocess.Popen(
+                    a, cwd=_REPO, env=env2, stdout=out_f, **kw),
+            )
+        return report, _sig(_last_json(stdout_path))
+
+    out: dict = {}
+    try:
+        _stamp("chaos: baseline unsharded run")
+        base_out = os.path.join(work, "base.out")
+        with open(base_out, "wb") as f:
+            base_rc = subprocess.run(
+                base_argv, cwd=_REPO, env=env, stdout=f).returncode
+        baseline = _sig(_last_json(base_out))
+        out["mc_chaos_baseline_rc"] = base_rc
+
+        # -- 1. preemption: SIGKILL after the first checkpoint ---------
+        _stamp("chaos: SIGKILL-after-checkpoint run")
+        ck_a = os.path.join(work, "ck_a.npz")
+        victim: list = []
+
+        def _kill_after_ckpt():
+            while not victim:
+                time.sleep(0.05)
+            p = victim[0]
+            while p.poll() is None and not os.path.exists(ck_a):
+                time.sleep(0.1)
+            if p.poll() is None:
+                time.sleep(0.3)  # into the next window, mid-flight
+                try:
+                    os.kill(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+
+        threading.Thread(target=_kill_after_ckpt, daemon=True).start()
+        rep_a, sig_a = _retry_run(
+            "kill", ["--mesh", "8", "--checkpoint-interval", "4",
+                     "--checkpoint-path", ck_a, "--diag-dir", work],
+            on_spawn=lambda p: victim.append(p) if not victim else None,
+        )
+        out.update({
+            "mc_chaos_ok": bool(
+                base_rc == 0 and rep_a["exit_code"] == 0
+                and rep_a["recoveries"] >= 1 and sig_a == baseline),
+            "mc_chaos_recoveries": rep_a["recoveries"],
+            "mc_chaos_mttr_s": (rep_a["mttr_s"] or [None])[0],
+            "mc_chaos_exit_history": rep_a["exit_history"],
+        })
+        print(json.dumps(out), flush=True)
+
+        # -- 2. peer loss: wedged collective -> 77 -> shrunken mesh ----
+        if _remaining() > 120:
+            _stamp("chaos: collective-stall (exit 77) run")
+            ck_b = os.path.join(work, "ck_b.npz")
+            rep_b, sig_b = _retry_run(
+                "peerlost",
+                ["--mesh", "8", "--collective-timeout", "5",
+                 "--checkpoint-interval", "4",
+                 "--checkpoint-path", ck_b, "--diag-dir", work],
+                hang_s=60.0,
+            )
+            bundles = glob.glob(os.path.join(work, "*.peerlost.*.json"))
+            out.update({
+                "mc_chaos_peerlost_ok": bool(
+                    rep_b["exit_code"] == 0
+                    and EXIT_PEER_LOST in rep_b["exit_history"]
+                    and bundles and sig_b == baseline),
+                "mc_chaos_peerlost_mttr_s": (rep_b["mttr_s"] or [None])[0],
+                "mc_chaos_peerlost_exit_history": rep_b["exit_history"],
+                "mc_chaos_peerlost_bundles": len(bundles),
+            })
+            print(json.dumps(out), flush=True)
+        else:
+            print("bench: skipping peer-loss scenario (budget exhausted)",
+                  file=sys.stderr)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    # merge into the newest MULTICHIP_r*.json (create one if the
+    # multichip stage hasn't run on this machine yet): the recovery
+    # numbers belong next to the scaling numbers they qualify
+    paths = [(int(m.group(1)), p) for p in
+             glob.glob(os.path.join(_REPO, "MULTICHIP_r*.json"))
+             if (m := _re.search(r"MULTICHIP_r(\d+)\.json$", p))]
+    if paths:
+        _, path = max(paths)
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            merged = {}
+        merged.update(out)
+    else:
+        path = os.path.join(_REPO, "MULTICHIP_r01.json")
+        merged = out
+    with open(path, "w") as f:
+        json.dump(merged, f, indent=2)
+        f.write("\n")
+    _stamp(f"chaos results -> {path}")
+
+
 def perf_smoke():
     """CPU PHOLD floor gate (measure_all.sh perf_smoke stage): a small
     fixed-shape PHOLD on the CPU backend, compared against the
@@ -770,6 +978,7 @@ def main():
                      ("--phold-big-worker", phold_big_worker),
                      ("--perf-smoke", perf_smoke),
                      ("--multichip-worker", multichip_worker),
+                     ("--chaos-worker", chaos_worker),
                      ("--skew-worker", skew_worker)):
         if flag in sys.argv:
             fn()

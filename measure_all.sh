@@ -27,6 +27,13 @@ run skew      900  --skew-worker
 # pass/fail; the worker also writes the superset to MULTICHIP_r*.json
 run multichip 2400 --multichip-worker JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 BENCH_BUDGET_S=2300
+# chaos smoke: the elastic-recovery acceptance (docs/13) — SIGKILL a
+# checkpointing 8-shard worker mid-window and wedge another one's
+# collective past --collective-timeout; both runs must recover through
+# the --retry path to a bit-identical summary. Results (recoveries,
+# MTTR, exit histories) merge into the newest MULTICHIP_r*.json.
+run chaos_smoke 900 --chaos-worker JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 BENCH_BUDGET_S=840
 # fast observability smoke: a short traced+profiled run through the CLI
 # plus the Chrome-trace exporter; only the summary JSON line joins $R
 # (stderr notes and heartbeat lines go to the stamp log)

@@ -35,6 +35,17 @@ way those disciplines have been (or nearly were) broken:
   can't with ``# shadowlint: no-donate=<reason>`` (the bare
   ``disable=SL107`` works too, but the reasoned marker is the
   documented mechanism — it forces the "why" into the source).
+- SL109 bare blocking device sync outside watchdog-scoped sites —
+  ``jax.device_get``/``.block_until_ready()`` OUTSIDE jit scope (SL101
+  owns the inside-jit case) blocks the driver until the device answers,
+  with no deadline: a lost mesh peer turns the call into an infinite
+  hang the stall watchdog can only attribute to "no progress". The
+  sanctioned blocking sites are ``runtime.harvest.HeartbeatHarvest``
+  (petted by the CLI's collective watchdog) and ``runtime/supervisor.py``
+  (the watchdog layer itself); every other site must carry
+  ``# shadowlint: no-deadline=<reason>`` — the reason is mandatory, so
+  each undeadlined sync documents why a hang there is acceptable
+  (docs/13-Elastic-Recovery.md).
 - SL108 collective call inside a ``while_loop``/``cond`` predicate —
   jax 0.4.x's experimental shard_map under ``check_rep=False``
   miscompiles collectives lowered into loop/branch predicates: device
@@ -67,6 +78,7 @@ RULES = {
     "SL106": "iteration over a set (nondeterministic order)",
     "SL107": "window-loop entry point jitted without donate_argnums",
     "SL108": "collective call inside a while_loop/cond predicate",
+    "SL109": "blocking device sync outside watchdog-scoped sites",
 }
 
 # SL107: callables by these names are window-loop entry points (the
@@ -140,6 +152,15 @@ _SUPPRESS_RE = re.compile(r"#\s*shadowlint:\s*disable(?:=([A-Z0-9,\s]+))?")
 # SL107's reasoned exemption: the reason is mandatory (an empty one
 # does not suppress), so every undonated entry point documents itself.
 _NO_DONATE_RE = re.compile(r"#\s*shadowlint:\s*no-donate=(\S.*)")
+# SL109's reasoned exemption, same contract: a bare `no-deadline=` does
+# not suppress — the reason documents why an unbounded block is safe.
+_NO_DEADLINE_RE = re.compile(r"#\s*shadowlint:\s*no-deadline=(\S.*)")
+
+# SL109 sanctioned blocking scopes: the harvest class whose fetch the
+# CLI pets its collective watchdog around, and the watchdog layer
+# itself (its whole job is bounding everyone else's blocking).
+_SL109_CLASS_ALLOWED = {"HeartbeatHarvest"}
+_SL109_FILE_ALLOWED = ("runtime/supervisor.py",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -400,6 +421,21 @@ class _Linter(ast.NodeVisitor):
                            f"`np.{node.func.attr}(...)` runs on host "
                            f"inside jit scope; use jnp")
 
+        # SL109: bare blocking sync OUTSIDE jit scope (SL101 owns the
+        # inside — the two are mutually exclusive by construction)
+        if not in_jit and isinstance(node.func, ast.Attribute):
+            blocking = (
+                node.func.attr == "block_until_ready"
+                or (node.func.attr == "device_get"
+                    and _attr_root(node.func) == "jax"))
+            if blocking and not self._sl109_allowed(node):
+                self._emit(
+                    "SL109", node,
+                    f"`{_unparse(node.func)}` blocks with no deadline — "
+                    f"a lost peer hangs here forever; fetch through "
+                    f"HeartbeatHarvest / a watchdog-petted site, or mark "
+                    f"the line `# shadowlint: no-deadline=<reason>`")
+
         # SL108: collectives lowered into a loop/branch predicate
         self._check_pred_collective(node, base)
 
@@ -413,6 +449,15 @@ class _Linter(ast.NodeVisitor):
         self._track_prng(node)
 
         self.generic_visit(node)
+
+    def _sl109_allowed(self, node: ast.Call) -> bool:
+        if self.path.replace(os.sep, "/").endswith(_SL109_FILE_ALLOWED):
+            return True
+        if any(s.name in _SL109_CLASS_ALLOWED for s in self.scopes):
+            return True
+        line = getattr(node, "lineno", 1)
+        return bool(1 <= line <= len(self.lines)
+                    and _NO_DEADLINE_RE.search(self.lines[line - 1]))
 
     def _mentions(self, node: ast.AST, names: set[str]) -> bool:
         if not names:

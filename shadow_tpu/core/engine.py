@@ -252,7 +252,7 @@ def state_summary(state: EngineState) -> dict:
     diagnostic bundle records as "last known progress": the frontier
     (clock) time, the window count, and the executed-event total.
     """
-    now, windows, executed, sweeps, drops = jax.device_get((
+    now, windows, executed, sweeps, drops = jax.device_get((  # shadowlint: no-deadline=diagnostic summary helper; not on the supervised loop
         state.now, state.stats.n_windows, state.stats.n_executed.sum(),
         state.stats.n_sweeps, state.queues.drops.sum(),
     ))
@@ -265,7 +265,7 @@ def state_summary(state: EngineState) -> dict:
     }
     ring = state.queues.spill
     if ring is not None:
-        spilled, lost, hwm = jax.device_get((
+        spilled, lost, hwm = jax.device_get((  # shadowlint: no-deadline=diagnostic summary helper; not on the supervised loop
             ring.n_spilled.sum(), ring.n_lost.sum(), ring.fill_hwm.max(),
         ))
         out["spilled"] = int(spilled)
@@ -490,7 +490,7 @@ class Engine:
         # static fast path: with no CPU model (the default), skip every
         # cpu_free compare/update in the compiled step — profiled at ~20%
         # of the PHOLD sweep as a [H*B]-lane gather of an all-zeros table
-        self._cpu_enabled = bool(jax.device_get((cpu_cost != 0).any()))
+        self._cpu_enabled = bool(jax.device_get((cpu_cost != 0).any()))  # shadowlint: no-deadline=build-time constant fetch; no collectives in flight
         # jitter rolls cost an extra uniform per emit row; skip them
         # entirely for jitter-free networks
         self._use_jitter = bool(getattr(network, "has_jitter", False))

@@ -253,7 +253,7 @@ def compile_faults(specs, names, n_hosts: int, seed: int) -> CompiledFaults:
             bw_specs.append((m, s2ns(sp.start), s2ns(sp.end), sp.factor))
             continue
         if sp.frac < 1.0:
-            u = np.asarray(jax.device_get(
+            u = np.asarray(jax.device_get(  # shadowlint: no-deadline=build-time fault-schedule sampling
                 srng.fault_stream_uniform(seed, si << 8, n_hosts)
             ))
             m = m & (u < sp.frac)
@@ -262,7 +262,7 @@ def compile_faults(specs, names, n_hosts: int, seed: int) -> CompiledFaults:
             for g in np.nonzero(m)[0]:
                 down.append((int(g), a, b if sp.restart else _T_INF))
         else:  # churn
-            phase = np.asarray(jax.device_get(
+            phase = np.asarray(jax.device_get(  # shadowlint: no-deadline=build-time fault-schedule sampling
                 srng.fault_stream_uniform(seed, (si << 8) | 1, n_hosts)
             )) * sp.period
             p_ns = int(round(sp.period * SECOND))

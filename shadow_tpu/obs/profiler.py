@@ -131,4 +131,4 @@ def queue_fill(state) -> float:
     occ = jnp.mean(
         (state.queues.time != TIME_INVALID).astype(jnp.float32)
     )
-    return float(jax.device_get(occ))
+    return float(jax.device_get(occ))  # shadowlint: no-deadline=profiler occupancy probe; off the hot loop

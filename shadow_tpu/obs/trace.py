@@ -192,7 +192,7 @@ class TraceDrain:
         """Harvest every record written since the last reset; returns the
         number of records drained. Call `reset_ring` (or `drain_state`)
         after, or the next drain re-reads the same rows."""
-        return self.ingest(jax.device_get(self.gather(ring)))
+        return self.ingest(jax.device_get(self.gather(ring)))  # shadowlint: no-deadline=trace drain; the caller overlaps it behind dispatch
 
     def ingest(self, fetched: dict) -> int:
         """Host-side half of `drain`: fold a fetched (numpy) `gather`

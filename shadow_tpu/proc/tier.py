@@ -534,7 +534,7 @@ class ProcessTier:
         seq = np.zeros((cap,), np.int32)
         kind = np.zeros((cap,), np.int32)
         argw = np.zeros((cap, N_PKT_ARGS), np.int32)
-        src_seq = np.array(jax.device_get(st.src_seq))
+        src_seq = np.array(jax.device_get(st.src_seq))  # shadowlint: no-deadline=proc-tier pump; covered by the stall watchdog's pets
         for i, (gid, args) in enumerate(rows):
             times[i] = now
             dst[i] = gid
@@ -564,7 +564,7 @@ class ProcessTier:
         net = st.hosts.net
         tstate, rx, fin_raw, fgen, lport, phost, pport, cgen = (
             np.asarray(x)
-            for x in jax.device_get((
+            for x in jax.device_get((  # shadowlint: no-deadline=proc-tier pump; covered by the stall watchdog's pets
                 net.tcb.state, net.sockets.rx_bytes, st.hosts.app.fin_seen,
                 st.hosts.app.fin_gen, net.sockets.local_port,
                 net.sockets.peer_host, net.sockets.peer_port,
@@ -583,7 +583,7 @@ class ProcessTier:
         if self._udp_used:
             app = st.hosts.app
             ucnt, usrc, usport, udport, _ulen, useq = (
-                np.asarray(x) for x in jax.device_get((
+                np.asarray(x) for x in jax.device_get((  # shadowlint: no-deadline=proc-tier pump; covered by the stall watchdog's pets
                     app.udp_cnt, app.udp_src, app.udp_sport,
                     app.udp_dport, app.udp_len, app.udp_seq,
                 ))
@@ -858,13 +858,13 @@ class ProcessTier:
                 # harvest/refill hook slots in at every boundary for
                 # free — sharing the frontier probe's device_get so the
                 # idle refill check costs no extra round-trip
-                now_a, wr = jax.device_get((st.now, st.queues.spill.wr))
+                now_a, wr = jax.device_get((st.now, st.queues.spill.wr))  # shadowlint: no-deadline=proc-tier pump; covered by the stall watchdog's pets
                 st = sim._note_owned(
                     sim.pressure.boundary(st, wr=np.asarray(wr))
                 )
                 now = int(now_a)
             else:
-                now = int(jax.device_get(st.now))
+                now = int(jax.device_get(st.now))  # shadowlint: no-deadline=proc-tier pump; covered by the stall watchdog's pets
             self._observe(st)
             if self._udp_zombie_deadline:
                 for zk in [k for k, d in self._udp_zombie_deadline.items()
@@ -872,7 +872,7 @@ class ProcessTier:
                     del self._udp_zombie_deadline[zk]
                     self._udp_src_zombies.pop(zk, None)
                     self._udp_outstanding.pop(zk, None)
-        drops = int(jax.device_get(st.queues.drops.sum()))
+        drops = int(jax.device_get(st.queues.drops.sum()))  # shadowlint: no-deadline=proc-tier pump; covered by the stall watchdog's pets
         if drops and self.overflow == "strict":
             from shadow_tpu.runtime.pressure import QueuePressureError
 

@@ -30,10 +30,15 @@ imported explicitly by the layers that need it.
 
 from shadow_tpu.runtime.supervisor import (  # noqa: F401
     EXIT_INVARIANT,
+    EXIT_PEER_LOST,
     EXIT_PRESSURE,
     EXIT_STALL,
+    RETRYABLE_EXITS,
     Supervisor,
     Watchdog,
+    exit_retryable,
+    next_retry_argv,
+    run_with_retry,
     signal_exit_code,
     write_diagnostic_bundle,
 )

@@ -240,7 +240,7 @@ class PressureController:
     def _harvest(self, state) -> Any:
         """Move every ring record into the reservoir heaps; reset wr."""
         ring = state.queues.spill
-        wr, t, ss, pay = jax.device_get(
+        wr, t, ss, pay = jax.device_get(  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
             (ring.wr, ring.time, ring.srcseq, ring.pay)
         )
         scap = t.shape[1] - self.capacity  # slack == queue capacity
@@ -320,7 +320,7 @@ class PressureController:
             return state
         self.boundaries += 1
         if wr is None:
-            wr = jax.device_get(ring.wr)
+            wr = jax.device_get(ring.wr)  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
         wr = np.asarray(wr)
         resident = sum(len(hp) for hp in self._heaps)
         if not wr.any() and resident == 0:
@@ -337,7 +337,7 @@ class PressureController:
         for _ in range(_MAX_REFILL_ROUNDS):
             if not any(self._heaps):
                 break
-            fill, maxt, maxss, now = jax.device_get(self._jit_probe(state))
+            fill, maxt, maxss, now = jax.device_get(self._jit_probe(state))  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
             horizon = int(now) + self.lookahead
             cand, per_host = self._collect(fill, maxt, maxss, horizon)
             n = len(cand["t"])
@@ -385,7 +385,7 @@ class PressureController:
             self.n_refilled += per_host
             # refill may evict displaced larger keys back into the ring:
             # harvest them immediately so the reservoir invariant holds
-            wr = np.asarray(jax.device_get(state.queues.spill.wr))
+            wr = np.asarray(jax.device_get(state.queues.spill.wr))  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
             if wr.any():
                 state = self._harvest(state)
             else:
@@ -405,7 +405,7 @@ class PressureController:
         inside the *next* window normally still refill in time via the
         demand rule (they displace larger device keys), so the wider
         horizon would count events that go on to execute correctly."""
-        now = int(jax.device_get(state.now))
+        now = int(jax.device_get(state.now))  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
         overdue = sum(
             1 for hp in self._heaps for rec in hp if rec[0] < now
         )
@@ -471,7 +471,7 @@ class PressureController:
         if ring is None:
             return {}
         self._ring_slots = int(ring.time.shape[1])
-        return self.snapshot_from(jax.device_get(self.gather(state)))
+        return self.snapshot_from(jax.device_get(self.gather(state)))  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
 
     # ------------------------------------------------- checkpoint support
     def serialize(self) -> dict[str, np.ndarray]:
@@ -538,7 +538,7 @@ def run_with_spill(engine, state, stop, controller: PressureController,
     state = jax.tree.map(
         lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
     )
-    while int(jax.device_get(state.now)) < int(stop):
+    while int(jax.device_get(state.now)) < int(stop):  # shadowlint: no-deadline=pressure is single-device only; no peer to lose
         state = step(state, stop, h0)
         state = controller.boundary(state)
     return state

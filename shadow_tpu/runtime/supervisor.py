@@ -19,6 +19,7 @@ import faulthandler
 import json
 import os
 import signal
+import subprocess
 import sys
 import threading
 import time
@@ -30,10 +31,25 @@ from typing import Any, Callable
 # 70 = EX_SOFTWARE (internal state corruption; do NOT blindly resume),
 # 76 = EX_PROTOCOL-adjacent (queue pressure under --overflow strict: the
 #      run is healthy but its results would be lossy; rerun with a larger
-#      --capacity or a lossless overflow mode).
+#      --capacity or a lossless overflow mode),
+# 77 = a collective deadline expired (a mesh peer died or wedged mid
+#      all_to_all / device_get: the survivors can never complete the
+#      collective; retryable on a SHRUNKEN mesh from the newest
+#      checkpoint — docs/13-Elastic-Recovery.md).
 EXIT_STALL = 75
 EXIT_INVARIANT = 70
 EXIT_PRESSURE = 76
+EXIT_PEER_LOST = 77
+
+# Exit statuses `run_with_retry` treats as transient: the two deadline
+# aborts above, plus any signal death (SIGKILL by the OOM killer or a
+# preemption, SIGTERM from a scheduler — Popen reports those as -N).
+RETRYABLE_EXITS = frozenset({EXIT_STALL, EXIT_PEER_LOST})
+
+
+def exit_retryable(rc: int) -> bool:
+    return rc in RETRYABLE_EXITS or rc < 0 or rc in (
+        signal_exit_code(signal.SIGKILL), signal_exit_code(signal.SIGTERM))
 
 
 def signal_exit_code(signum: int) -> int:
@@ -82,6 +98,8 @@ class Watchdog:
                  label: str = "shadow_tpu",
                  info: Callable[[], dict] | None = None,
                  exit_code: int = EXIT_STALL,
+                 kind: str = "stall",
+                 compile_grace: bool = False,
                  _exit: Callable[[int], Any] = os._exit,
                  _stream=None):
         if timeout_s <= 0:
@@ -90,6 +108,20 @@ class Watchdog:
         self.diag_dir = diag_dir
         self.label = label
         self.exit_code = exit_code
+        # bundle-file kind: "stall" for the classic per-window deadline,
+        # "peerlost" for the collective deadline — distinct names so one
+        # run can leave both without clobbering
+        self.kind = kind
+        # collective deadlines must not count JIT lowering/compile time:
+        # any window can miss the executable cache (a new mesh shape
+        # after reshard, a re-templated capacity) and block for tens of
+        # seconds with every peer perfectly healthy. With compile_grace
+        # the expiry check inspects the main thread's Python stack and
+        # re-arms instead of firing while it shows jax compiler/lowering
+        # frames — a genuinely wedged collective blocks inside
+        # pxla ExecuteReplicated / the runtime's C++, never there.
+        self.compile_grace = bool(compile_grace)
+        self.compile_graces = 0
         self._info = info
         self._exit = _exit  # injectable so unit tests survive a firing
         self._stream = _stream  # defaults to sys.stderr at fire time
@@ -133,19 +165,47 @@ class Watchdog:
             return self.timeout_s - (time.monotonic() - self._last_pet)
 
     # ------------------------------------------------------------- firing
+    def _main_thread_compiling(self) -> bool:
+        """True when the main thread's stack shows jax lowering/compile
+        frames — the benign unbounded-wall-time case a collective
+        deadline must wave through (see compile_grace)."""
+        try:
+            frame = sys._current_frames().get(threading.main_thread().ident)
+        except Exception:
+            return False
+        while frame is not None:
+            fn = frame.f_code.co_filename.replace(os.sep, "/")
+            if ("/jax/_src/compiler.py" in fn
+                    or "/jax/_src/interpreters/mlir.py" in fn
+                    or "/jaxlib/mlir/" in fn):
+                return True
+            frame = frame.f_back
+        return False
+
     def _loop(self) -> None:
         poll = min(1.0, max(self.timeout_s / 4.0, 0.05))
         while not self._stop.wait(poll):
             with self._lock:
                 stalled_for = time.monotonic() - self._last_pet
             if stalled_for > self.timeout_s:
+                if self.compile_grace and self._main_thread_compiling():
+                    with self._lock:
+                        self._last_pet = time.monotonic()
+                    self.compile_graces += 1
+                    print(
+                        f"{self.label}: {self.kind} deadline extended — "
+                        f"main thread is compiling "
+                        f"(grace {self.compile_graces})",
+                        file=self._stream or sys.stderr, flush=True,
+                    )
+                    continue
                 self._fire(stalled_for)
                 return
 
     def _fire(self, stalled_for: float) -> None:
         self.fired = True
         pid = os.getpid()
-        base = os.path.join(self.diag_dir, f"{self.label}.stall.{pid}")
+        base = os.path.join(self.diag_dir, f"{self.label}.{self.kind}.{pid}")
         stream = self._stream or sys.stderr
         try:
             os.makedirs(self.diag_dir, exist_ok=True)
@@ -161,10 +221,15 @@ class Watchdog:
                 progress = dict(self._progress)
                 n_pets = self._n_pets
             bundle = {
-                "reason": "watchdog: no window progress within deadline",
+                "reason": (
+                    "watchdog: no window progress within deadline"
+                    if self.kind == "stall" else
+                    f"watchdog: {self.kind} deadline expired"
+                ),
                 "timeout_s": self.timeout_s,
                 "stalled_for_s": round(stalled_for, 3),
                 "windows_reported": n_pets,
+                "compile_graces": self.compile_graces,
                 "progress": progress,
                 "pid": pid,
                 "exit_code": self.exit_code,
@@ -286,3 +351,107 @@ class Supervisor:
 
     def margin_s(self) -> float | None:
         return self.watchdog.margin_s() if self.watchdog is not None else None
+
+
+# --------------------------------------------------------------- retry loop
+def next_retry_argv(argv: list[str], rc: int, *, mesh_flag: str = "--mesh",
+                    shrink: bool = False) -> list[str]:
+    """The relaunch command for a failed worker: force
+    `--resume auto-if-any` (the relaunch must pick up the newest valid
+    checkpoint when there is one, but a worker that died before its
+    first checkpoint simply restarts from zero) and, when `shrink` (a
+    peer was lost — its devices are gone), halve the mesh so the
+    survivors can host the whole run."""
+    argv = list(argv)
+    if "--resume" not in argv and not any(
+            a.startswith("--resume=") for a in argv):
+        argv += ["--resume", "auto-if-any"]
+    if shrink:
+        for i, a in enumerate(argv):
+            if a == mesh_flag and i + 1 < len(argv):
+                argv[i + 1] = str(max(1, int(argv[i + 1]) // 2))
+                break
+            if a.startswith(mesh_flag + "="):
+                argv[i] = (
+                    f"{mesh_flag}={max(1, int(a.split('=', 1)[1]) // 2)}")
+                break
+    return argv
+
+
+def run_with_retry(argv: list[str], *, retries: int,
+                   backoff_s: float = 1.0, mesh_flag: str = "--mesh",
+                   on_spawn: Callable[[Any], None] | None = None,
+                   _sleep: Callable[[float], None] = time.sleep,
+                   _popen: Callable[..., Any] = subprocess.Popen) -> dict:
+    """Supervise `argv` as a subprocess, relaunching from the newest
+    valid checkpoint after transient failures (`cli.py --retry N`).
+
+    Each attempt runs in its own session (process group) so that when a
+    worker dies abnormally we can reap every survivor it left behind —
+    the stuck XLA runtime threads, a wedged plugin — with one
+    `killpg(SIGKILL)` before relaunching. Retryable exits are
+    `exit_retryable`: stall (75), peer-lost (77), and signal deaths
+    (preemption's SIGKILL included). A peer-lost exit additionally
+    halves `--mesh` on the relaunch: the lost peer's devices are not
+    coming back, so the survivors must host all shards. Backoff is
+    exponential: backoff_s, 2*backoff_s, 4*backoff_s, ...
+
+    Returns a report dict: attempts, recoveries, exit_code (the final
+    attempt's), exit_history, and mttr_s — per-recovery seconds from
+    failure detection to the replacement's first sign of life (first
+    stderr output, or its exit when it stays silent). `on_spawn(proc)`
+    is called per attempt (the chaos harness uses it to find its
+    victim). Deliberately jax-free, like the rest of this module.
+    """
+    report: dict = {"attempts": 0, "recoveries": 0, "exit_code": None,
+                    "exit_history": [], "mttr_s": []}
+    argv = list(argv)
+    fail_t: float | None = None
+    for attempt in range(retries + 1):
+        report["attempts"] += 1
+        first_out: list = [None]
+        proc = _popen(argv, start_new_session=True, stderr=subprocess.PIPE)
+
+        def _tee(stream, mark):
+            for line in iter(stream.readline, b""):
+                if mark[0] is None:
+                    mark[0] = time.monotonic()
+                sys.stderr.buffer.write(line)
+                sys.stderr.flush()
+
+        tee = None
+        if proc.stderr is not None:
+            tee = threading.Thread(
+                target=_tee, args=(proc.stderr, first_out), daemon=True)
+            tee.start()
+        if on_spawn is not None:
+            on_spawn(proc)
+        rc = proc.wait()
+        if tee is not None:
+            tee.join(timeout=5.0)
+        if fail_t is not None:
+            alive_t = first_out[0] if first_out[0] is not None \
+                else time.monotonic()
+            report["mttr_s"].append(round(alive_t - fail_t, 3))
+        report["exit_history"].append(rc)
+        if rc == 0 or not exit_retryable(rc) or attempt == retries:
+            report["exit_code"] = rc
+            return report
+        fail_t = time.monotonic()
+        # reap the dead worker's whole process group: survivors holding
+        # device locks or half-open collectives would wedge the relaunch
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        report["recoveries"] += 1
+        argv = next_retry_argv(argv, rc, mesh_flag=mesh_flag,
+                               shrink=(rc == EXIT_PEER_LOST))
+        print(
+            f"shadow_tpu: attempt {attempt + 1} exited {rc} (retryable); "
+            f"relaunching in {backoff_s * (2 ** attempt):.1f}s: "
+            f"{' '.join(argv)}",
+            file=sys.stderr, flush=True,
+        )
+        _sleep(backoff_s * (2 ** attempt))
+    return report  # unreachable; loop always returns

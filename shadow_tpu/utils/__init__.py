@@ -2,6 +2,9 @@ from shadow_tpu.utils.checkpoint import (  # noqa: F401
     checkpoint_generations,
     find_resume_checkpoint,
     load_checkpoint,
+    load_shard_set,
+    read_header_info,
     save_checkpoint,
+    shard_member_path,
     verify_checkpoint,
 )

@@ -26,9 +26,11 @@ that verifies wins, corrupt ones are skipped with a reason.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import re
+import time
 import zipfile
 import zlib
 from typing import Any
@@ -47,8 +49,29 @@ import numpy as np
 # EventQueue.drops widened i32 -> i64. Loading still accepts v3/v4: an
 # integer leaf whose checkpoint dtype is narrower than the template's is
 # widened in place (lossless), so pre-widening checkpoints keep resuming.
-FORMAT_VERSION = 5
-_LOADABLE_VERSIONS = (3, 4, 5)
+# v6: mesh-portable metadata for elastic reshard-on-resume
+# (docs/13-Elastic-Recovery.md): the header records the writer's mesh
+# shape (`mesh`: n_shards / dcn_slices / host_order) and whether the
+# cross-shard exchange buffer was empty (`xchg_empty` — always true at a
+# window boundary because the engine flushes in-flight events before
+# returning), plus optional `shard` [i, n] identity for per-worker
+# shard-set members. Leaves are unchanged — they were already host-major
+# global arrays — so v3/v4/v5 files still load; they just carry no mesh
+# info and are treated as mesh-unconstrained on resume.
+FORMAT_VERSION = 6
+_LOADABLE_VERSIONS = (3, 4, 5, 6)
+
+# Bounded retry for transient IO failure during the atomic write:
+# EINTR (a signal landing mid-fsync — the supervisor's SIGUSR1
+# checkpoint-now path makes that likely), ENOSPC (rotation or an
+# external cleaner may free space between attempts), EAGAIN. Anything
+# else propagates immediately. `_io_sleep` is module-level so tests can
+# stub the backoff.
+_IO_RETRY_ERRNOS = (errno.EINTR, errno.ENOSPC, errno.EAGAIN)
+_IO_ATTEMPTS = 5
+_IO_BACKOFF_S = 0.05
+_io_sleep = time.sleep
+_savez = np.savez_compressed
 
 
 def _leaf_paths(tree: Any) -> list[str]:
@@ -96,9 +119,86 @@ def checkpoint_generations(path: str) -> list[str]:
     return out
 
 
+def _is_xchg(path: str) -> bool:
+    return path.startswith(".xchg")
+
+
+def _xchg_empty(paths: list[str], leaves: list[np.ndarray]) -> bool:
+    """True when the cross-shard exchange buffer holds no in-flight
+    events: every occupancy-bearing xchg leaf (`.time` slots and the
+    `sent_min` barrier) is all TIME_INVALID (int max). Non-xchg trees
+    are trivially empty."""
+    empty = True
+    for pth, arr in zip(paths, leaves):
+        if not _is_xchg(pth):
+            continue
+        if pth.endswith(".time") or pth.endswith("sent_min"):
+            if arr.dtype.kind == "i":
+                empty &= bool(np.all(arr == np.iinfo(arr.dtype).max))
+    return empty
+
+
+def _is_spill(path: str) -> bool:
+    return path.startswith(".queues.spill")
+
+
+def _spill_empty(paths: list[str], leaves: list[np.ndarray]) -> bool:
+    """True when the overflow spill ring parked nothing: occupancy is a
+    prefix below the per-host write cursor, so empty means every `.wr`
+    is zero. Trees without a spill subtree are trivially empty."""
+    empty = True
+    for pth, arr in zip(paths, leaves):
+        if _is_spill(pth) and pth.endswith(".wr"):
+            empty &= bool(np.all(arr == 0))
+    return empty
+
+
+def shard_member_path(path: str, index: int, count: int) -> str:
+    """File name of one member of a sharded checkpoint set."""
+    return f"{path}.shard{index}of{count}"
+
+
+def _write_atomic(path: str, arrs: dict[str, np.ndarray],
+                  keep: int = 1) -> None:
+    """write-tmp / fsync / atomic-rename / fsync-dir, with bounded
+    backoff on transient errno — a crash mid-write (the very event
+    checkpoints guard against) cannot destroy the previous good
+    checkpoint, and a power loss cannot persist the rename without the
+    data."""
+    tmp = path + ".tmp"
+    for attempt in range(_IO_ATTEMPTS):
+        try:
+            with open(tmp, "wb") as f:
+                _savez(f, **arrs)
+                f.flush()
+                os.fsync(f.fileno())
+            break
+        except OSError as e:
+            # reclaim the partial file first — on ENOSPC it IS the
+            # space we need back
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            if (e.errno not in _IO_RETRY_ERRNOS
+                    or attempt == _IO_ATTEMPTS - 1):
+                raise
+            _io_sleep(_IO_BACKOFF_S * (2 ** attempt))
+    if keep > 1:
+        _rotate(path, keep)
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def save_checkpoint(path: str, state: Any, meta: dict | None = None,
                     keep: int = 1,
-                    extra: dict[str, np.ndarray] | None = None) -> None:
+                    extra: dict[str, np.ndarray] | None = None,
+                    mesh_info: dict | None = None,
+                    shard: tuple[int, int] | None = None) -> None:
     """Write `state` (any pytree of arrays) to `path` as .npz.
 
     `keep > 1` rotates: the previous `path` becomes `path.1` (and so on
@@ -110,41 +210,44 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None,
     .serialize()); they are CRC'd like leaves but excluded from the
     template structure match on load, so the same checkpoint loads with
     or without a controller attached.
+
+    `mesh_info` (v6) records the writer's mesh so `--resume auto` can
+    restore onto a different shard count: {"n_shards", "dcn_slices",
+    "host_order" (the applied locality permutation, or None for config
+    order)}. `shard=(i, n)` writes one member of a sharded set to
+    `shard_member_path(path, i, n)` instead of `path` (no rotation —
+    set atomicity is all-or-none at resume, not per member).
     """
     leaves, _ = jax.tree_util.tree_flatten(state)
-    leaves = [np.asarray(x) for x in jax.device_get(leaves)]
+    leaves = [np.asarray(x) for x in jax.device_get(leaves)]  # shadowlint: no-deadline=checkpoint save; the CLI pets its watchdog at this site
+    paths = _leaf_paths(state)
     extra = {k: np.asarray(v) for k, v in (extra or {}).items()}
     header = {
         "format_version": FORMAT_VERSION,
         "n_leaves": len(leaves),
-        "paths": _leaf_paths(state),
+        "paths": paths,
         "shapes": [list(np.shape(x)) for x in leaves],
         "dtypes": [str(x.dtype) for x in leaves],
         "crc32": [_crc(x) for x in leaves],
         "extra": {k: _crc(v) for k, v in sorted(extra.items())},
         "meta": meta or {},
+        "xchg_empty": _xchg_empty(paths, leaves),
     }
+    if mesh_info is not None:
+        header["mesh"] = dict(mesh_info)
+    if shard is not None:
+        i, n = shard
+        if not (0 <= i < n):
+            raise ValueError(f"shard index {i} out of range for set of {n}")
+        header["shard"] = [i, n]
+        path = shard_member_path(path, i, n)
+        keep = 1
     arrs = {f"leaf_{i}": x for i, x in enumerate(leaves)}
     arrs.update({f"extra_{k}": v for k, v in extra.items()})
     arrs["__header__"] = np.frombuffer(
         json.dumps(header).encode("utf-8"), dtype=np.uint8
     )
-    # write-fsync-rename so a crash mid-write (the very event checkpoints
-    # guard against) cannot destroy the previous good checkpoint, and a
-    # power loss cannot persist the rename without the data
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **arrs)
-        f.flush()
-        os.fsync(f.fileno())
-    if keep > 1:
-        _rotate(path, keep)
-    os.replace(tmp, path)
-    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
-    try:
-        os.fsync(dfd)
-    finally:
-        os.close(dfd)
+    _write_atomic(path, arrs, keep=keep)
 
 
 def _read_raw(path: str) -> tuple[dict, list[np.ndarray]]:
@@ -219,48 +322,198 @@ def read_extra(path: str) -> dict[str, np.ndarray]:
         ) from e
 
 
-def find_resume_checkpoint(path: str):
-    """`--resume auto`: newest generation of `path` that verifies.
+def read_header_info(path: str) -> dict:
+    """Light header read (no leaf data): {"format_version", "meta",
+    "mesh" (None for pre-v6), "xchg_empty", "shard"}. Raises the same
+    ValueError as `_read_raw` on container damage."""
+    try:
+        with np.load(path) as data:
+            header = json.loads(bytes(data["__header__"]).decode("utf-8"))
+    except (zipfile.BadZipFile, KeyError, EOFError, OSError, ValueError,
+            json.JSONDecodeError) as e:
+        raise ValueError(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e})"
+        ) from e
+    return {
+        "format_version": header.get("format_version"),
+        "meta": header.get("meta", {}),
+        "mesh": header.get("mesh"),
+        "xchg_empty": header.get("xchg_empty", True),
+        "shard": header.get("shard"),
+    }
 
-    Returns (chosen_path, meta, skipped) where skipped is a list of
-    (path, reason) for newer generations that failed verification;
-    returns None when no generation files exist at all. Raises
-    ValueError when generations exist but none verifies.
+
+def _shard_sets(path: str) -> dict[int, dict[int, str]]:
+    """{set_size: {member_index: member_path}} for files named
+    `<path>.shard<i>of<n>` next to `path`."""
+    base = os.path.basename(path)
+    d = os.path.dirname(os.path.abspath(path))
+    sets: dict[int, dict[int, str]] = {}
+    if os.path.isdir(d):
+        pat = re.compile(re.escape(base) + r"\.shard(\d+)of(\d+)$")
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                i, n = int(m.group(1)), int(m.group(2))
+                sets.setdefault(n, {})[i] = os.path.join(
+                    os.path.dirname(path) or ".", name)
+    return sets
+
+
+def find_resume_checkpoint(path: str):
+    """`--resume auto`: newest checkpoint of `path` that verifies.
+
+    Candidates, newest-mtime first: rotation generations (`path`,
+    `path.1`, …), the crash-path `path.emergency` file, and complete
+    sharded sets (`path.shard<i>of<n>` — every member present and
+    verifying, all-or-none; a torn set is never resumed, it is reported
+    in `skipped` instead).
+
+    Returns (chosen, meta, skipped) where `chosen` is a single path, or
+    a list of member paths (shard order) for a set — load the latter
+    with `load_shard_set`. `skipped` lists (path, reason) for newer
+    candidates that failed. Returns None when nothing checkpoint-like
+    exists; raises ValueError when candidates exist but none verifies.
     """
-    gens = checkpoint_generations(path)
-    if not gens:
-        return None
     skipped: list[tuple[str, str]] = []
-    for p in gens:
-        try:
-            meta = verify_checkpoint(p)
-        except ValueError as e:
-            skipped.append((p, str(e)))
+    # (mtime, tiebreak, chosen, member_paths) — tiebreak keeps the
+    # historical generation order when mtimes collide
+    cands: list[tuple[float, int, Any, list[str]]] = []
+    for i, p in enumerate(checkpoint_generations(path)):
+        cands.append((os.path.getmtime(p), i, p, [p]))
+    emerg = path + ".emergency"
+    if os.path.exists(emerg):
+        # written at crash time, so usually the newest and the furthest
+        # along; ties with the bare path prefer the emergency file
+        cands.append((os.path.getmtime(emerg), -1, emerg, [emerg]))
+    for n, members in sorted(_shard_sets(path).items()):
+        if sorted(members) != list(range(n)):
+            got = ", ".join(
+                os.path.basename(members[i]) for i in sorted(members))
+            skipped.append((
+                shard_member_path(path, 0, n).replace("0of", "*of", 1),
+                f"incomplete shard set: {len(members)} of {n} members "
+                f"present ({got}) — refusing to resume a torn state",
+            ))
             continue
-        return p, meta, skipped
+        paths_n = [members[i] for i in range(n)]
+        cands.append((
+            max(os.path.getmtime(p) for p in paths_n), 0,
+            paths_n if n > 1 else paths_n[0], paths_n,
+        ))
+    if not cands:
+        if skipped:
+            raise ValueError(
+                "no verifiable checkpoint:\n  "
+                + "\n  ".join(f"{p}: {r}" for p, r in skipped)
+            )
+        return None
+    cands.sort(key=lambda c: (-c[0], c[1]))
+    for _, _, chosen, member_paths in cands:
+        try:
+            meta = {}
+            for p in member_paths:
+                meta = verify_checkpoint(p)
+        except ValueError as e:
+            skipped.append((
+                member_paths[0] if len(member_paths) == 1
+                else str(member_paths), str(e)))
+            continue
+        return chosen, meta, skipped
     raise ValueError(
         "no verifiable checkpoint generation:\n  "
         + "\n  ".join(f"{p}: {r}" for p, r in skipped)
     )
 
 
-def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:
+def _check_leaf(arr: np.ndarray, tmpl: Any, pth: str, want_crc,
+                path: str, i) -> np.ndarray:
+    """Shape/dtype/CRC validation of one checkpoint leaf against its
+    template leaf, with the lossless int-widening migration (v4 -> v5
+    widened EventQueue.drops to i64): CRC is verified against the
+    stored bytes FIRST, then the widening brings the leaf to the
+    template dtype."""
+    want_shape = tuple(np.shape(tmpl))
+    want_dtype = (
+        np.asarray(tmpl).dtype if not hasattr(tmpl, "dtype")
+        else tmpl.dtype
+    )
+    widen = (
+        arr.shape == want_shape
+        and str(arr.dtype) != str(want_dtype)
+        and arr.dtype.kind == np.dtype(want_dtype).kind == "i"
+        and arr.dtype.itemsize < np.dtype(want_dtype).itemsize
+    )
+    if (arr.shape != want_shape
+            or str(arr.dtype) != str(want_dtype)) and not widen:
+        raise ValueError(
+            f"leaf {i} ({pth}): checkpoint {arr.shape}/{arr.dtype} vs "
+            f"template {want_shape}/{want_dtype}"
+        )
+    if want_crc is not None and _crc(arr) != want_crc:
+        raise ValueError(
+            f"checkpoint {path!r}: CRC mismatch on leaf {i} ({pth}) — "
+            "the file was damaged after it was written"
+        )
+    if widen:
+        arr = arr.astype(want_dtype)
+    return arr
+
+
+def load_checkpoint(path: str, template: Any, *,
+                    reshard: bool = False) -> tuple[Any, dict]:
     """Load a checkpoint into the structure of `template`.
 
     Returns (state, meta). Raises ValueError on container corruption,
-    per-leaf CRC mismatch, or structural mismatch — checkpoint files are
-    only portable across identical builds (same config, host count,
-    socket/queue capacities).
+    per-leaf CRC mismatch, or structural mismatch.
+
+    With `reshard=False` (default) checkpoint files are only portable
+    across identical builds (same config, host count, socket/queue
+    capacities, mesh shape). With `reshard=True`, leaves are matched by
+    tree path and the `.xchg` subtree — the only mesh-shaped part of
+    the state — may differ: a checkpoint taken at S shards restores
+    onto a template built for S' shards (including S or S' == 1, where
+    the xchg subtree is absent entirely) by taking the template's
+    freshly-initialized exchange buffer. That is only sound when the
+    checkpoint's exchange buffer held no in-flight events; the engine
+    flushes it before every window boundary, so any checkpoint written
+    by the driver qualifies — but a file claiming otherwise is refused
+    loudly rather than dropping events. The `.queues.spill` overflow
+    ring gets the same treatment when exactly one side has it (sharded
+    builds refuse spill modes, so a reshard legitimately crosses spill
+    presence): template-fresh when the stored ring parked nothing,
+    refused when it did.
     """
     header, leaves = _read_raw(path)
     t_leaves, treedef = jax.tree_util.tree_flatten(template)
-    if header["n_leaves"] != len(t_leaves):
-        raise ValueError(
-            f"checkpoint has {header['n_leaves']} leaves, template has "
-            f"{len(t_leaves)} — was it built from the same config?"
-        )
     paths = _leaf_paths(template)
-    if header["paths"] != paths:
+    crcs = header.get("crc32") or [None] * len(leaves)
+    exact = header["paths"] == paths and header["n_leaves"] == len(t_leaves)
+    if exact and reshard:
+        # same tree, but possibly a different mesh: S and S' shards both
+        # HAVE an exchange buffer, just differently shaped — route those
+        # through the portable branch below instead of failing the
+        # strict per-leaf shape check
+        exact = all(
+            arr.shape == tuple(np.shape(tmpl))
+            for pth, arr, tmpl in zip(paths, leaves, t_leaves)
+            if _is_xchg(pth)
+        )
+    if exact:
+        new_leaves = [
+            jax.numpy.asarray(_check_leaf(arr, tmpl, pth, want_crc, path, i))
+            for i, (tmpl, pth, arr, want_crc) in enumerate(
+                zip(t_leaves, paths, leaves, crcs))
+        ]
+        return (jax.tree_util.tree_unflatten(treedef, new_leaves),
+                header.get("meta", {}))
+    if not reshard:
+        if header["n_leaves"] != len(t_leaves):
+            raise ValueError(
+                f"checkpoint has {header['n_leaves']} leaves, template has "
+                f"{len(t_leaves)} — was it built from the same config?"
+            )
         diff = [
             f"  {a} (checkpoint) vs {b} (template)"
             for a, b in zip(header["paths"], paths)
@@ -270,41 +523,160 @@ def load_checkpoint(path: str, template: Any) -> tuple[Any, dict]:
             "checkpoint tree structure differs from template:\n"
             + "\n".join(diff[:10])
         )
-    crcs = header.get("crc32") or [None] * len(leaves)
+    # --- mesh-portable path: match leaves by tree path ------------------
+    c_non_xchg = [p for p in header["paths"] if not _is_xchg(p)]
+    t_non_xchg = [p for p in paths if not _is_xchg(p)]
+    # The spill ring exists only under --overflow spill/grow, which
+    # sharded builds refuse — so a reshard legitimately crosses spill
+    # presence (the unsharded CLI default is spill, the sharded default
+    # drop). Treat the subtree like the exchange buffer: take it fresh
+    # from the template, provided the checkpoint's ring parked nothing.
+    spill_mismatch = (
+        c_non_xchg != t_non_xchg
+        and [p for p in c_non_xchg if not _is_spill(p)]
+        == [p for p in t_non_xchg if not _is_spill(p)]
+    )
+    if spill_mismatch:
+        if not _spill_empty(header["paths"], leaves):
+            raise ValueError(
+                f"checkpoint {path!r} holds spilled events in its "
+                "overflow ring — resume once with --overflow spill on "
+                "the original mesh to re-seat them, then reshard."
+            )
+        c_non_xchg = [p for p in c_non_xchg if not _is_spill(p)]
+        t_non_xchg = [p for p in t_non_xchg if not _is_spill(p)]
+    if c_non_xchg != t_non_xchg:
+        diff = [f"  {a} (checkpoint) vs {b} (template)"
+                for a, b in zip(c_non_xchg, t_non_xchg) if a != b]
+        if len(c_non_xchg) != len(t_non_xchg):
+            diff.append(
+                f"  {len(c_non_xchg)} non-exchange leaves (checkpoint) vs "
+                f"{len(t_non_xchg)} (template)")
+        raise ValueError(
+            "checkpoint differs from template beyond the mesh-shaped "
+            "exchange buffer — reshard needs the same config/host count:\n"
+            + "\n".join(diff[:10])
+        )
+    by_path = {
+        p: (arr, crc)
+        for p, arr, crc in zip(header["paths"], leaves, crcs)
+    }
+    if not _xchg_empty(header["paths"], leaves):
+        raise ValueError(
+            f"checkpoint {path!r} holds in-flight cross-shard events "
+            "(non-empty exchange buffer) — it cannot restore onto a "
+            "different mesh. Resume once on the original shard count to "
+            "reach a window boundary, then reshard."
+        )
     new_leaves = []
-    for i, (tmpl, pth, arr, want_crc) in enumerate(
-        zip(t_leaves, paths, leaves, crcs)
-    ):
-        want_shape = tuple(np.shape(tmpl))
-        want_dtype = (
-            np.asarray(tmpl).dtype if not hasattr(tmpl, "dtype")
-            else tmpl.dtype
-        )
-        widen = (
-            arr.shape == want_shape
-            and str(arr.dtype) != str(want_dtype)
-            and arr.dtype.kind == np.dtype(want_dtype).kind == "i"
-            and arr.dtype.itemsize < np.dtype(want_dtype).itemsize
-        )
-        if (arr.shape != want_shape
-                or str(arr.dtype) != str(want_dtype)) and not widen:
+    for i, (tmpl, pth) in enumerate(zip(t_leaves, paths)):
+        mesh_shaped = _is_xchg(pth) or (spill_mismatch and _is_spill(pth))
+        if pth in by_path and (
+                not mesh_shaped
+                or by_path[pth][0].shape == tuple(np.shape(tmpl))):
+            arr, want_crc = by_path[pth]
+            arr = _check_leaf(arr, tmpl, pth, want_crc, path, i)
+            new_leaves.append(jax.numpy.asarray(arr))
+        elif mesh_shaped:
+            # the target mesh's own (empty) exchange buffer or spill
+            # ring — the checkpoint's was verified empty above, so no
+            # event is lost
+            new_leaves.append(tmpl)
+        else:
             raise ValueError(
-                f"leaf {i} ({pth}): checkpoint {arr.shape}/{arr.dtype} vs "
-                f"template {want_shape}/{want_dtype}"
+                f"leaf {pth} missing from checkpoint {path!r}"
             )
-        if want_crc is not None and _crc(arr) != want_crc:
-            raise ValueError(
-                f"checkpoint {path!r}: CRC mismatch on leaf {i} ({pth}) — "
-                "the file was damaged after it was written"
-            )
-        if widen:
-            # dtype migration (v4 -> v5 widened EventQueue.drops to i64):
-            # CRC is verified against the stored bytes above, THEN the
-            # lossless int widening brings the leaf to the template dtype
-            arr = arr.astype(want_dtype)
-        new_leaves.append(jax.numpy.asarray(arr))
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return state, header.get("meta", {})
+
+
+def load_shard_set(member_paths: list[str], template: Any,
+                   ) -> tuple[Any, dict]:
+    """Load a complete sharded checkpoint set (one file per worker,
+    from `save_checkpoint(..., shard=(i, n))`) into a global template.
+
+    Per-host leaves (leading dim == global hosts / n members in every
+    member) are concatenated in shard order; replicated leaves (same
+    shape as the template) must agree bit-for-bit across members and
+    are taken from member 0; exchange-buffer leaves must be empty in
+    every member and come fresh from the template. Returns
+    (state, meta-of-member-0). Extras are refused (the pressure
+    reservoir never coexists with a sharded mesh).
+    """
+    n = len(member_paths)
+    read = [_read_raw(p) for p in member_paths]
+    for p, (hdr, lvs) in zip(member_paths, read):
+        shard = hdr.get("shard")
+        if shard is not None and shard[1] != n:
+            raise ValueError(
+                f"{p!r} belongs to a set of {shard[1]}, got {n} members")
+        if hdr.get("extra"):
+            raise ValueError(
+                f"{p!r} carries extra arrays; sharded sets cannot hold "
+                "a pressure reservoir")
+        if hdr["paths"] != read[0][0]["paths"]:
+            raise ValueError(
+                f"{p!r}: leaf paths differ from {member_paths[0]!r}")
+        if not _xchg_empty(hdr["paths"], lvs):
+            raise ValueError(
+                f"{p!r} holds in-flight cross-shard events — the set "
+                "cannot restore onto a different mesh")
+    c_paths = read[0][0]["paths"]
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    paths = _leaf_paths(template)
+    c_non_xchg = [p for p in c_paths if not _is_xchg(p)]
+    t_non_xchg = [p for p in paths if not _is_xchg(p)]
+    # members were written by sharded builds, which refuse spill modes —
+    # an unsharded target template may still carry the (default) spill
+    # ring, which starts fresh exactly like the exchange buffer
+    spill_mismatch = (
+        c_non_xchg != t_non_xchg
+        and c_non_xchg == [p for p in t_non_xchg if not _is_spill(p)]
+    )
+    if spill_mismatch:
+        t_non_xchg = [p for p in t_non_xchg if not _is_spill(p)]
+    if c_non_xchg != t_non_xchg:
+        raise ValueError(
+            "shard set differs from template beyond the exchange buffer "
+            "— was it written from the same config?"
+        )
+    idx = {p: i for i, p in enumerate(c_paths)}
+    new_leaves = []
+    for tmpl, pth in zip(t_leaves, paths):
+        if _is_xchg(pth) or (spill_mismatch and _is_spill(pth)):
+            new_leaves.append(tmpl)
+            continue
+        i = idx[pth]
+        want_shape = tuple(np.shape(tmpl))
+        members = []
+        for p, (hdr, lvs) in zip(member_paths, read):
+            crc = (hdr.get("crc32") or [None] * len(lvs))[i]
+            arr = lvs[i]
+            if crc is not None and _crc(arr) != crc:
+                raise ValueError(
+                    f"checkpoint {p!r}: CRC mismatch on leaf {i} ({pth}) "
+                    "— the file was damaged after it was written")
+            members.append(arr)
+        shapes = {m.shape for m in members}
+        if len(shapes) == 1 and members[0].shape == want_shape:
+            for p, m in zip(member_paths[1:], members[1:]):
+                if not np.array_equal(members[0], m):
+                    raise ValueError(
+                        f"replicated leaf {pth} differs between "
+                        f"{member_paths[0]!r} and {p!r}")
+            arr = members[0]
+        elif (len(shapes) == 1 and want_shape
+                and members[0].shape[1:] == want_shape[1:]
+                and members[0].shape[0] * n == want_shape[0]):
+            arr = np.concatenate(members, axis=0)
+        else:
+            raise ValueError(
+                f"leaf {pth}: member shape {members[0].shape} does not "
+                f"tile template {want_shape} across {n} shards")
+        arr = _check_leaf(arr, tmpl, pth, None, member_paths[0], pth)
+        new_leaves.append(jax.numpy.asarray(arr))
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state, read[0][0].get("meta", {})
 
 
 def transfer_state(state: Any, template: Any) -> Any:
@@ -338,7 +710,7 @@ def transfer_state(state: Any, template: Any) -> Any:
     out = []
     for pth, (src, tmpl) in zip(t_paths, zip(
             (leaf for _, leaf in s_flat), t_leaves)):
-        arr = np.asarray(jax.device_get(src))
+        arr = np.asarray(jax.device_get(src))  # shadowlint: no-deadline=offline state transfer during re-template
         want_shape = tuple(np.shape(tmpl))
         want_dtype = np.dtype(
             tmpl.dtype if hasattr(tmpl, "dtype") else np.asarray(tmpl).dtype

@@ -222,7 +222,7 @@ class CaptureDrain:
         return {"t": cap.t, "meta": cap.meta, "wr": cap.wr}
 
     def drain(self, cap: CaptureRing) -> None:
-        self.ingest(jax.device_get(self.gather(cap)))
+        self.ingest(jax.device_get(self.gather(cap)))  # shadowlint: no-deadline=pcap drain; off the supervised loop
 
     def ingest(self, fetched: dict) -> None:
         """Host-side half of `drain`: decode a fetched (numpy) `gather`

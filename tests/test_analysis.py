@@ -310,6 +310,63 @@ def test_sl108_carried_flag_clean():
     assert fs == []
 
 
+def test_sl109_blocking_sync_outside_jit():
+    fs = _lint("""
+        import jax
+        def poll(st):
+            now = int(jax.device_get(st.now))
+            st.queues.drops.block_until_ready()
+            return now
+    """)
+    assert _rules(fs) == ["SL109"] and len(fs) == 2
+
+
+def test_sl109_in_jit_is_sl101_not_sl109():
+    # mutually exclusive by construction: inside jit scope the same
+    # calls are SL101's host-materialization finding
+    fs = _lint("""
+        import jax
+        @jax.jit
+        def f(x):
+            return jax.device_get(x)
+    """)
+    assert _rules(fs) == ["SL101"]
+
+
+def test_sl109_watchdog_scoped_sites_allowed():
+    src = """
+        import jax
+        class HeartbeatHarvest:
+            def fetch(self, bundle):
+                return jax.device_get(bundle)
+    """
+    assert _lint(src) == []
+    # the watchdog layer itself is allowed by path
+    plain = """
+        import jax
+        def reap(st):
+            return jax.device_get(st.now)
+    """
+    assert _lint(plain, "shadow_tpu/runtime/supervisor.py") == []
+    assert _rules(_lint(plain, "shadow_tpu/runtime/other.py")) == ["SL109"]
+
+
+def test_sl109_no_deadline_exemption_needs_reason():
+    # the reasoned marker suppresses; a bare `no-deadline=` does not
+    ok = _lint("""
+        import jax
+        def probe(st):
+            return jax.device_get(st.now)  # shadowlint: no-deadline=build-time fetch
+    """)
+    assert ok == []
+    bare = _lint("""
+        import jax
+        def probe(st):
+            return jax.device_get(st.now)  # shadowlint: no-deadline=
+    """)
+    assert _rules(bare) == ["SL109"]
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng
