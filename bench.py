@@ -862,6 +862,177 @@ def chaos_worker():
     _stamp(f"chaos results -> {path}")
 
 
+def metrics_smoke_worker():
+    """Live-telemetry acceptance (measure_all.sh metrics_smoke stage,
+    docs/14-Telemetry.md): one slow supervised run with `--metrics-port
+    0`, scraped while it runs and again after its summary prints.
+
+    Gates, each recorded in the JSON superset and fatal on failure:
+
+      1. exporter determinism — two mid-run scrapes with no heartbeat
+         between them are byte-identical;
+      2. OpenMetrics syntax — every scrape passes
+         `obs.metrics.validate_openmetrics` (the same checker behind
+         tools/check_openmetrics.py);
+      3. /healthz answers 200 with status "ok" on a clean run;
+      4. reconciliation — the final scrape's counter samples equal the
+         end-of-run summary JSON exactly (events, drops, bytes, ...).
+
+    SHADOW_TPU_METRICS_LINGER_S keeps the endpoint alive after the
+    summary lands so gate 4 scrapes the *finalized* registry."""
+    import re as _re
+    import subprocess
+    import urllib.request
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(_REPO, ".jax_cache_cpu")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHADOW_TPU_METRICS_LINGER_S"] = "20"
+
+    from shadow_tpu.obs.metrics import validate_openmetrics
+
+    argv = [sys.executable, "-m", "shadow_tpu", "--test",
+            "--stoptime", "30", "--heartbeat-frequency", "2",
+            "--seed", "1", "--metrics-port", "0"]
+    out: dict = {}
+    proc = subprocess.Popen(argv, cwd=_REPO, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+    def _fail(msg: str):
+        proc.kill()
+        out["metrics_smoke_ok"] = False
+        out["metrics_smoke_error"] = msg
+        print(json.dumps(out), flush=True)
+        print(f"metrics_smoke: {msg}", file=sys.stderr)
+        sys.exit(1)
+
+    # the serving line appears on stderr once jax import + build finish
+    port = None
+    stderr_lines: list[str] = []
+    deadline = time.monotonic() + min(300.0, max(_remaining() - 60, 60.0))
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                break
+            continue
+        stderr_lines.append(line)
+        m = _re.search(r"metrics: serving http://[\d.]+:(\d+)/metrics", line)
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        _fail("server line never appeared: "
+              + "".join(stderr_lines[-5:]).strip())
+    out["metrics_smoke_port"] = port
+    _stamp(f"metrics_smoke: scraping port {port}")
+
+    def _get(path: str) -> tuple[int, str]:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+
+    def _samples(text: str) -> dict[str, float]:
+        vals = {}
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            name_lbl, _, v = ln.rpartition(" ")
+            vals[name_lbl] = float(v)
+        return vals
+
+    # 1./2. determinism + syntax, mid-run: a heartbeat may land between
+    # two scrapes (that is real state change, not nondeterminism), so
+    # hunt for one byte-identical consecutive pair
+    identical = False
+    for _ in range(5):
+        a, b = _get("/metrics")[1], _get("/metrics")[1]
+        if a == b:
+            identical = True
+            break
+    out["metrics_smoke_deterministic"] = identical
+    problems = validate_openmetrics(b)
+    out["metrics_smoke_openmetrics_violations"] = len(problems)
+    status, health_body = _get("/healthz")
+    health = json.loads(health_body)
+    out["metrics_smoke_healthz"] = health.get("status")
+    if not identical:
+        _fail("two no-heartbeat scrapes never matched byte-for-byte")
+    if problems:
+        _fail("openmetrics violations: " + "; ".join(problems[:4]))
+    if status != 200 or health.get("status") != "ok":
+        _fail(f"/healthz {status} {health_body.strip()}")
+
+    # 4. follow stdout to the summary line, then scrape the *finalized*
+    # registry inside the SHADOW_TPU_METRICS_LINGER_S window — the same
+    # "scrape after the run's last heartbeat" a shell harness would do
+    import threading
+
+    threading.Thread(target=proc.stderr.read, daemon=True).start()
+    stdout_lines: list[str] = []
+    summary: dict = {}
+    deadline = time.monotonic() + max(_remaining() - 30, 60)
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        stdout_lines.append(line)
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "events" in cand:
+                summary = cand
+                break
+    if not summary:
+        proc.kill()
+        _fail("summary line never appeared on stdout")
+    final_text = _get("/metrics")[1]
+    out["metrics_smoke_final_violations"] = len(
+        validate_openmetrics(final_text))
+    final = _samples(final_text)
+    proc.stdout.read()  # drain until the linger window ends the process
+    rc = proc.wait(timeout=60)
+    out["metrics_smoke_rc"] = rc
+
+    recon_ok = rc == 0 and not out["metrics_smoke_final_violations"]
+    for key in ("events", "windows", "queue_drops", "net_dropped",
+                "fault_dropped", "quarantined_events",
+                "cross_shard_packets", "rx_bytes", "tx_bytes"):
+        want = int(summary.get(key, 0))
+        got = final.get(f"shadow_tpu_{key}_total")
+        if got is None or int(got) != want:
+            recon_ok = False
+            out[f"metrics_smoke_mismatch_{key}"] = [want, got]
+    # the [metrics] heartbeat rows are the same registry logged in-band;
+    # the last row must agree with the scrape (exporter vs tracker)
+    from shadow_tpu.tools.parse_shadow import parse_lines
+
+    met = parse_lines(stdout_lines)["metrics"]
+    rows_ok = bool(met["ticks"]) and all(
+        met[k][-1] == int(final.get(f"shadow_tpu_{k}_total", -1))
+        for k in ("events", "queue_drops", "rx_bytes", "tx_bytes")
+    )
+    # mid-run scrape must never exceed the final totals (counters only
+    # move forward)
+    monotone_ok = all(
+        _samples(b).get(s, 0) <= final.get(s, 0)
+        for s in ("shadow_tpu_events_total", "shadow_tpu_rx_bytes_total")
+    )
+    out["metrics_smoke_reconciled"] = recon_ok
+    out["metrics_smoke_rows_match_scrape"] = rows_ok
+    out["metrics_smoke_monotonic"] = monotone_ok
+    out["metrics_smoke_events"] = int(summary.get("events", 0))
+    out["metrics_smoke_ok"] = recon_ok and rows_ok and monotone_ok
+    print(json.dumps(out), flush=True)
+    if not out["metrics_smoke_ok"]:
+        print("metrics_smoke: reconciliation failed", file=sys.stderr)
+        sys.exit(1)
+
+
 def perf_smoke():
     """CPU PHOLD floor gate (measure_all.sh perf_smoke stage): a small
     fixed-shape PHOLD on the CPU backend, compared against the
@@ -979,6 +1150,7 @@ def main():
                      ("--perf-smoke", perf_smoke),
                      ("--multichip-worker", multichip_worker),
                      ("--chaos-worker", chaos_worker),
+                     ("--metrics-smoke-worker", metrics_smoke_worker),
                      ("--skew-worker", skew_worker)):
         if flag in sys.argv:
             fn()

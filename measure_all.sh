@@ -63,6 +63,14 @@ for mode in drop spill grow strict; do
     echo "pressure_smoke_strict: unexpected exit $rc" >> "$S"
   fi
 done
+# metrics smoke: the live-telemetry acceptance (docs/14-Telemetry.md) —
+# a slow supervised run with --metrics-port 0, scraped mid-run (two
+# no-heartbeat scrapes byte-identical, OpenMetrics syntax clean via
+# tools/check_openmetrics, /healthz ok) and again after the summary
+# lands inside the SHADOW_TPU_METRICS_LINGER_S window; the final scrape
+# must equal the run summary and the in-band [metrics] rows exactly.
+run metrics_smoke 900 --metrics-smoke-worker JAX_PLATFORMS=cpu \
+  BENCH_BUDGET_S=840
 # perf smoke: a small CPU-backend PHOLD against the checked-in
 # PERF_FLOOR.json floor — fails (exit 1) when events/s regresses more
 # than 30%. Together with the lint + hlo_audit stage below this is the

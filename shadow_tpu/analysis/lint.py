@@ -46,6 +46,13 @@ way those disciplines have been (or nearly were) broken:
   ``# shadowlint: no-deadline=<reason>`` — the reason is mandatory, so
   each undeadlined sync documents why a hang there is acceptable
   (docs/13-Elastic-Recovery.md).
+- SL110 wall-clock read inside jit scope — ``time.time()``/
+  ``time.perf_counter()``/``time.monotonic()`` (and their ``_ns``
+  variants) return Python floats/ints, so inside a traced function the
+  "timestamp" freezes into a compile-time constant: every later call
+  of the compiled program sees the clock of its first trace. Wall
+  timing belongs on host around the jit (``obs.WindowProfiler``); a
+  timestamp a kernel needs must be threaded in as an argument.
 - SL108 collective call inside a ``while_loop``/``cond`` predicate —
   jax 0.4.x's experimental shard_map under ``check_rep=False``
   miscompiles collectives lowered into loop/branch predicates: device
@@ -79,6 +86,17 @@ RULES = {
     "SL107": "window-loop entry point jitted without donate_argnums",
     "SL108": "collective call inside a while_loop/cond predicate",
     "SL109": "blocking device sync outside watchdog-scoped sites",
+    "SL110": "wall-clock read inside jit scope",
+}
+
+# SL110: time-module entry points that read the wall clock. Bare-name
+# calls (``from time import perf_counter``) match everything except
+# plain ``time`` — a bare ``time()`` is far more often a shadowed
+# variable than the stdlib call, and the module-qualified form covers
+# the real uses.
+_WALLCLOCK_ATTRS = {
+    "time", "perf_counter", "monotonic",
+    "time_ns", "perf_counter_ns", "monotonic_ns",
 }
 
 # SL107: callables by these names are window-loop entry points (the
@@ -421,6 +439,16 @@ class _Linter(ast.NodeVisitor):
                            f"`np.{node.func.attr}(...)` runs on host "
                            f"inside jit scope; use jnp")
 
+        # SL110: wall-clock reads in jit scope — the call traces to a
+        # host float, so the "timestamp" is a compile-time constant
+        if in_jit and self._is_wallclock_call(node):
+            self._emit(
+                "SL110", node,
+                f"`{_unparse(node.func)}()` inside jit scope freezes "
+                f"the wall clock into a compile-time constant; time on "
+                f"host around the jit (obs.WindowProfiler) or thread "
+                f"the timestamp in as an argument")
+
         # SL109: bare blocking sync OUTSIDE jit scope (SL101 owns the
         # inside — the two are mutually exclusive by construction)
         if not in_jit and isinstance(node.func, ast.Attribute):
@@ -449,6 +477,15 @@ class _Linter(ast.NodeVisitor):
         self._track_prng(node)
 
         self.generic_visit(node)
+
+    @staticmethod
+    def _is_wallclock_call(node: ast.Call) -> bool:
+        if isinstance(node.func, ast.Attribute):
+            return (node.func.attr in _WALLCLOCK_ATTRS
+                    and _attr_root(node.func) in ("time", "_time"))
+        if isinstance(node.func, ast.Name):
+            return node.func.id in _WALLCLOCK_ATTRS - {"time"}
+        return False
 
     def _sl109_allowed(self, node: ast.Call) -> bool:
         if self.path.replace(os.sep, "/").endswith(_SL109_FILE_ALLOWED):

@@ -210,11 +210,35 @@ def make_parser() -> argparse.ArgumentParser:
                         "plus per-window occupancy; adds a 'profile' key "
                         "to the summary line and per-phase tracks to the "
                         "exported trace")
+    p.add_argument("--metrics", action="store_true",
+                   help="live telemetry registry: fold the heartbeat "
+                        "harvest's counters into an OpenMetrics-renderable "
+                        "registry and emit a [metrics] heartbeat section "
+                        "(docs/14-Telemetry.md). Rides the existing "
+                        "single-fetch harvest bundle — no extra device "
+                        "round-trips; off, the compiled program is "
+                        "byte-identical")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve /metrics (OpenMetrics), /healthz, and "
+                        "/summary.json on 127.0.0.1:PORT from a background "
+                        "thread (0 = ephemeral port, printed to stderr); "
+                        "implies --metrics")
+    p.add_argument("--xprof", default=None, metavar="START:STOP",
+                   help="capture a device profiler trace "
+                        "(jax.profiler.start_trace/stop_trace) across the "
+                        "window segments between sim seconds START and "
+                        "STOP, into --xprof-dir; the exported event trace "
+                        "references the directory so Perfetto can show "
+                        "sim-time tracks and device traces side by side "
+                        "(docs/14-Telemetry.md)")
+    p.add_argument("--xprof-dir", default="shadow_tpu_xprof",
+                   metavar="DIR",
+                   help="output directory for the --xprof trace")
     p.add_argument("--show-build-info", action="store_true")
     return p
 
 
-def _make_observability(cfg, sim, args, trace=None):
+def _make_observability(cfg, sim, args, trace=None, metrics=None):
     """Logger + tracker honoring the config's per-host loglevel and
     heartbeatloginfo attrs (tracker.c:433-561; shadow_logger.c:102-121)."""
     from shadow_tpu.config import expand_hosts
@@ -237,7 +261,7 @@ def _make_observability(cfg, sim, args, trace=None):
     tracker = Tracker(
         sim.names, logger, log_info=("node",), info_of=info_of,
         level_of=level_of, faults=sim.faults, trace=trace,
-        pressure=sim.pressure,
+        pressure=sim.pressure, metrics=metrics,
     )
     return logger, tracker
 
@@ -360,6 +384,10 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if args.metrics or args.metrics_port is not None or args.xprof:
+            print("note: --metrics/--metrics-port/--xprof are device-tier "
+                  "flags (they ride the heartbeat harvest); the process "
+                  "tier ignores them", file=sys.stderr)
         unsupported = []
         if args.resume:
             unsupported.append("--resume")
@@ -463,6 +491,26 @@ def main(argv=None) -> int:
                   "tier has no checkpoint to write", file=sys.stderr)
             return sup.exit_code()
         return 0 if all(c == 0 for c in tier.exit_codes.values()) else 1
+
+    # --xprof parse before the expensive build: a malformed span should
+    # fail in milliseconds, not after compilation
+    xprof_span = None
+    if args.xprof:
+        try:
+            a, sep, b = args.xprof.partition(":")
+            if not sep:
+                raise ValueError("missing ':'")
+            xprof_span = (float(a), float(b))
+        except ValueError:
+            print(f"error: --xprof must be START:STOP in sim seconds, "
+                  f"got {args.xprof!r}", file=sys.stderr)
+            return 2
+        if xprof_span[0] < 0 or xprof_span[1] <= xprof_span[0]:
+            print(f"error: --xprof needs 0 <= START < STOP, got "
+                  f"{args.xprof!r}", file=sys.stderr)
+            return 2
+    xprof_active = False
+    xprof_done = False
 
     t0 = time.perf_counter()
     mesh = None
@@ -669,7 +717,53 @@ def main(argv=None) -> int:
     ck = args.checkpoint_interval
     next_hb = (math.floor(sim_s / hb) + 1) * hb if hb > 0 else float("inf")
     next_ckpt = (math.floor(sim_s / ck) + 1) * ck if ck > 0 else float("inf")
-    logger, tracker = _make_observability(cfg, sim, args, trace=tdrain)
+
+    # -- live telemetry plane (docs/14-Telemetry.md): flight recorder
+    # (always on — it's two bounded deques, and abnormal exits ship it),
+    # /healthz state machine, and — under --metrics — the registry the
+    # harvest bundle populates and the tracker's [metrics] row reads
+    from shadow_tpu.obs.metrics import (
+        FlightRecorder, HealthState, MetricsRegistry,
+    )
+
+    metrics_on = args.metrics or args.metrics_port is not None
+    recorder = FlightRecorder()
+    health = HealthState()
+    _retry_attempt = os.environ.get("SHADOW_TPU_RETRY_ATTEMPT")
+    if _retry_attempt:
+        # run_with_retry marks relaunched children; a run that needed a
+        # relaunch reports degraded even though it is making progress
+        health.relaunch(int(_retry_attempt))
+    registry = None
+    if metrics_on:
+        registry = MetricsRegistry(version=__version__,
+                                   n_shards=args.mesh or 1)
+    server = None
+    if args.metrics_port is not None:
+        from shadow_tpu.obs.server import MetricsServer
+
+        try:
+            server = MetricsServer(registry, health, recorder,
+                                   port=args.metrics_port).start()
+        except OSError as e:
+            print(f"error: --metrics-port {args.metrics_port}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    def _close_metrics():
+        # SHADOW_TPU_METRICS_LINGER_S keeps the endpoints up briefly
+        # after the summary line so harnesses (measure_all.sh
+        # metrics_smoke) can take their final reconciliation scrape
+        if server is None:
+            return
+        linger_s = float(
+            os.environ.get("SHADOW_TPU_METRICS_LINGER_S") or 0)
+        if linger_s > 0:
+            time.sleep(linger_s)
+        server.close()
+
+    logger, tracker = _make_observability(cfg, sim, args, trace=tdrain,
+                                          metrics=registry)
     drain = None
     if sim.pcap_gids:
         from shadow_tpu.utils.pcap import CaptureDrain
@@ -692,7 +786,8 @@ def main(argv=None) -> int:
         watchdog_timeout=args.watchdog, diag_dir=args.diag_dir,
         info=lambda: {"tier": "device",
                       "checkpoint_path": args.checkpoint_path,
-                      "config_digest": cfg_digest},
+                      "config_digest": cfg_digest,
+                      "flight_recorder": recorder.snapshot()},
     )
     sup_hb = SupervisorHeartbeat(logger, watchdog=sup.watchdog)
 
@@ -724,6 +819,7 @@ def main(argv=None) -> int:
                 "checkpoint_path": args.checkpoint_path,
                 "config_digest": cfg_digest,
                 "last_summary": dict(last_summary),
+                "flight_recorder": recorder.snapshot(),
             }
 
         cwd = Watchdog(
@@ -785,7 +881,7 @@ def main(argv=None) -> int:
     from shadow_tpu.runtime.harvest import HeartbeatHarvest
 
     harvest = HeartbeatHarvest(sim, tracker=tracker, tdrain=tdrain,
-                               pcap=drain)
+                               pcap=drain, metrics=registry)
     pending_hb = None  # (fetched bundle, sim_ns, summary) to consume
 
     def consume_hb():
@@ -827,6 +923,8 @@ def main(argv=None) -> int:
                 },
             )
         sup_hb.checkpoint_written()
+        recorder.record_event("checkpoint", sim_seconds=sim_s,
+                              path=path or args.checkpoint_path)
         if cwd is not None and cwd_armed:
             # checkpoint IO is a legitimate pause; don't let it eat the
             # next window's collective deadline
@@ -846,7 +944,38 @@ def main(argv=None) -> int:
     try:
         with sup:
             while sim_s < stop_s:
+                if xprof_span is not None and not xprof_done:
+                    # span edges are segment boundaries (joined into
+                    # `nxt` below), so start/stop bracket whole window
+                    # segments; both edges pet the collective watchdog —
+                    # profiler IO is a legitimate pause, not a lost peer
+                    if xprof_active and sim_s >= xprof_span[1]:
+                        jax.profiler.stop_trace()
+                        xprof_active, xprof_done = False, True
+                        recorder.record_event("xprof-stop",
+                                              sim_seconds=sim_s)
+                        print(f"xprof: capture stopped at sim "
+                              f"{sim_s:.3f}s -> {args.xprof_dir}",
+                              file=sys.stderr)
+                        if cwd is not None and cwd_armed:
+                            cwd.pet(site="xprof-stop")
+                    elif not xprof_active and sim_s >= xprof_span[0]:
+                        jax.profiler.start_trace(args.xprof_dir)
+                        xprof_active = True
+                        recorder.record_event("xprof-start",
+                                              sim_seconds=sim_s,
+                                              dir=args.xprof_dir)
+                        print(f"xprof: capturing device trace from sim "
+                              f"{sim_s:.3f}s -> {args.xprof_dir}",
+                              file=sys.stderr)
+                        if cwd is not None and cwd_armed:
+                            cwd.pet(site="xprof-start")
                 nxt = min(next_hb, next_ckpt, stop_s)
+                if xprof_span is not None and not xprof_done:
+                    edge = (xprof_span[1] if xprof_active
+                            else xprof_span[0])
+                    if edge > sim_s:
+                        nxt = min(nxt, edge)
                 stop_i = int(nxt * SECOND)
                 full_hb = nxt >= next_hb
                 if cwd is not None and cwd_armed:
@@ -937,16 +1066,54 @@ def main(argv=None) -> int:
                     # the re-templated state
                     harvest.rebind(sim)
                     summary_now = sim.summary(st)
+                    recorder.record_event("grow-retemplate",
+                                          sim_seconds=sim_s,
+                                          capacity=new_cap)
+                    # the rebuilt harvest hasn't extracted yet at this
+                    # boundary; take the telemetry extras in a one-off
+                    # fetch from the re-templated state
+                    metrics_extras = (
+                        jax.device_get(sim.metrics_refs(st))  # shadowlint: no-deadline=one-shot grow re-template fetch; the next segment's harvest resumes the overlap
+                        if metrics_on else None
+                    )
                 else:
                     summary_now = harvest.summary_from(fetched)
+                    metrics_extras = fetched.get("metrics")
                     if sim.pressure is None:
                         # run()'s loud-overflow probe, from the already-
                         # fetched bundle (spill/grow never count drops)
                         sim.check_drops(summary_now["queue_drops"],
                                         summary_now)
+                # the stall margin BEFORE the pet resets the deadline —
+                # this is how close the segment came to exit 75
+                stall_margin = (sup.watchdog.margin_s()
+                                if sup.watchdog is not None else None)
                 sup.pet(sim_seconds=sim_s, **summary_now)
                 last_summary.update(summary_now, sim_seconds=sim_s)
                 sup_hb.observe_margin()
+                recorder.record_heartbeat(int(sim_s * SECOND),
+                                          summary_now)
+                if stall_margin is not None and health.observe_margin(
+                        stall_margin, args.watchdog):
+                    recorder.record_event(
+                        "watchdog-near-miss", sim_seconds=sim_s,
+                        margin_s=round(stall_margin, 3))
+                if health.code() == 0 and (
+                        summary_now.get("spilled", 0)
+                        or summary_now.get("queue_drops", 0)):
+                    health.pressure_event()
+                    recorder.record_event(
+                        "pressure", sim_seconds=sim_s,
+                        spilled=int(summary_now.get("spilled", 0)),
+                        queue_drops=int(
+                            summary_now.get("queue_drops", 0)))
+                if metrics_on:
+                    registry.ingest(summary_now, extras=metrics_extras,
+                                    fill=float(fetched["fill"]))
+                    registry.observe(
+                        watchdog_margin_s=stall_margin,
+                        checkpoints=sup_hb.checkpoints_written,
+                        health=health, profiler=prof)
                 if args.validate > 0 and (
                     summary_now["windows"] - last_validated_windows
                     >= args.validate
@@ -993,20 +1160,37 @@ def main(argv=None) -> int:
     except InvariantViolation as e:
         # deliberately NO checkpoint here: the state just failed its own
         # consistency checks, and writing it would rotate a known-good
-        # generation out in favor of a corrupt one
-        print(f"shadow_tpu: INVARIANT VIOLATION at sim {sim_s:.3f}s\n{e}",
+        # generation out in favor of a corrupt one — but it DOES get a
+        # diagnostic bundle now, with the flight-recorder ring: the
+        # heartbeats leading up to a corruption are the post-mortem
+        from shadow_tpu.runtime import write_diagnostic_bundle
+
+        health.fail(EXIT_INVARIANT)
+        path = write_diagnostic_bundle(
+            args.diag_dir, "shadow_tpu", "invariant",
+            {"reason": str(e), "sim_seconds": sim_s,
+             "exit_code": EXIT_INVARIANT,
+             "flight_recorder": recorder.snapshot()},
+        )
+        print(f"shadow_tpu: INVARIANT VIOLATION at sim {sim_s:.3f}s\n{e}"
+              f"\ndiagnostic bundle -> {path}",
               file=sys.stderr)
+        _close_metrics()
         return EXIT_INVARIANT
     except QueuePressureError as e:
         # --overflow strict: the state is healthy (nothing was actually
         # lost — the run stopped at the first would-be drop), but the
         # campaign's no-loss contract is broken; leave a machine-readable
         # bundle and the distinct exit code instead of a stack trace
+        health.fail(EXIT_PRESSURE)
         path = pressure_bundle(e, diag_dir=args.diag_dir,
-                               label="shadow_tpu")
+                               label="shadow_tpu",
+                               extra={"flight_recorder":
+                                      recorder.snapshot()})
         print(f"shadow_tpu: QUEUE PRESSURE at sim {sim_s:.3f}s under "
               f"--overflow strict: {e}\ndiagnostic bundle -> {path}",
               file=sys.stderr)
+        _close_metrics()
         return EXIT_PRESSURE
     except BaseException as e:
         # unhandled driver failure: best-effort emergency checkpoint of
@@ -1028,6 +1212,13 @@ def main(argv=None) -> int:
         # device ring was already reset — consume it first or they're lost
         if cwd is not None and cwd_armed:
             cwd.stop()
+        if xprof_active:
+            # interrupted/failed runs keep the partial device capture
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            xprof_active = False
         try:
             consume_hb()
         except Exception:
@@ -1051,7 +1242,13 @@ def main(argv=None) -> int:
             tdrain.save(
                 args.trace_out,
                 profile=prof.export() if prof is not None else None,
-                extra_meta={"seed": args.seed, "tier": "device"},
+                extra_meta={
+                    "seed": args.seed, "tier": "device",
+                    # the exported Chrome trace references the device
+                    # capture so Perfetto shows both side by side
+                    **({"xprof_dir": args.xprof_dir}
+                       if xprof_span is not None else {}),
+                },
             )
             print(f"event trace: {tdrain.n_records} records -> "
                   f"{args.trace_out}"
@@ -1064,6 +1261,7 @@ def main(argv=None) -> int:
         print(f"interrupted by signal {sup.stop_signum}: checkpoint at "
               f"{args.checkpoint_path} (sim {sim_s:.3f}s of {stop_s:.0f}s); "
               "resume with --resume auto", file=sys.stderr)
+        _close_metrics()
         return sup.exit_code()
 
     stats = st.stats
@@ -1118,7 +1316,20 @@ def main(argv=None) -> int:
         }
     if prof is not None:
         summary["profile"] = prof.summary()
-    print(json.dumps(summary))
+    if xprof_span is not None:
+        summary["xprof"] = {"dir": args.xprof_dir,
+                            "start": xprof_span[0],
+                            "stop": xprof_span[1],
+                            "completed": xprof_done}
+    if metrics_on:
+        # align the registry with the printed totals (the post-loop
+        # fetches above are authoritative — they see the final state
+        # after the trace drain), so the last scrape reconciles exactly
+        registry.finalize(summary)
+        registry.observe(checkpoints=sup_hb.checkpoints_written,
+                         health=health, profiler=prof)
+    print(json.dumps(summary), flush=True)
+    _close_metrics()
     return 0
 
 

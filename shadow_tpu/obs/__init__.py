@@ -1,6 +1,6 @@
-"""Observability: device-side event tracing + window-phase profiling.
+"""Observability: tracing, profiling, and the live telemetry plane.
 
-Two halves, deliberately decoupled:
+Decoupled halves:
 
 - `trace`: an on-device ring buffer (`TraceRing`) that the engine's
   jitted window loop appends per-event records into under a static
@@ -10,10 +10,18 @@ Two halves, deliberately decoupled:
 - `profiler`: a host-side wall-clock phase timer (`WindowProfiler`)
   for the un-jitted skeleton of the run loop (build, jitted step, host
   drain, shim pump, checkpoint) plus per-window occupancy sampling.
+- `metrics` + `server`: the live telemetry plane — a declared-once
+  `MetricsRegistry` populated from the `HeartbeatHarvest` single-fetch
+  bundle, rendered as OpenMetrics text over a stdlib HTTP server
+  (`/metrics`, `/healthz`, `/summary.json`), plus the `FlightRecorder`
+  ring that diagnostic bundles serialize and the `HealthState`
+  machine behind `/healthz`.
 
-Neither half costs anything when off: the trace ring is `None` in
+None of it costs anything when off: the trace ring is `None` in
 `EngineState` (zero pytree leaves — identical compiled program,
-identical checkpoint leaf list), and the profiler is simply absent.
+identical checkpoint leaf list), the profiler is simply absent, and
+with `--metrics` off the harvest extraction lowers byte-identically
+(pinned via `analysis.hlo_audit.assert_zero_cost`).
 """
 
 from shadow_tpu.obs.trace import (  # noqa: F401
@@ -29,3 +37,14 @@ from shadow_tpu.obs.trace import (  # noqa: F401
     trace_append,
 )
 from shadow_tpu.obs.profiler import WindowProfiler, queue_fill  # noqa: F401
+from shadow_tpu.obs.metrics import (  # noqa: F401
+    METRICS_HEADER,
+    SPECS,
+    FlightRecorder,
+    HealthState,
+    MetricSpec,
+    MetricsRegistry,
+    metrics_device_refs,
+    validate_openmetrics,
+)
+from shadow_tpu.obs.server import MetricsServer  # noqa: F401

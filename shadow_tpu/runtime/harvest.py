@@ -47,11 +47,17 @@ class HeartbeatHarvest:
     donation safe (`Simulation._fresh_state`).
     """
 
-    def __init__(self, sim, *, tracker=None, tdrain=None, pcap=None):
+    def __init__(self, sim, *, tracker=None, tdrain=None, pcap=None,
+                 metrics=None):
         self.sim = sim
         self.tracker = tracker
         self.tdrain = tdrain
         self.pcap = pcap
+        # truthy => embed the live-telemetry reductions
+        # (obs.metrics.metrics_device_refs) in the extraction bundle.
+        # Off, the extraction lowers byte-identically to pre-metrics —
+        # the --metrics zero-cost pin.
+        self.metrics = metrics
         self._jits: dict[bool, Any] = {}
 
     def rebind(self, sim) -> None:
@@ -74,6 +80,7 @@ class HeartbeatHarvest:
         has_trace = tdrain is not None and sim.state0.trace is not None
         has_pcap = pcap is not None and sim.state0.hosts.net.cap is not None
         has_ring = sim.state0.queues.spill is not None
+        has_metrics = self.metrics is not None
 
         def extract(state):
             q = state.queues
@@ -98,6 +105,12 @@ class HeartbeatHarvest:
                 bundle["summary"]["fill_hwm"] = ring.fill_hwm.max()
             if sim.pressure is not None:
                 bundle["pressure"] = sim.pressure.gather(state)
+            if has_metrics:
+                from shadow_tpu.obs.metrics import metrics_device_refs
+
+                # a handful of extra global reductions riding the same
+                # single fetch — the exporter's live counters
+                bundle["metrics"] = metrics_device_refs(state)
             if full:
                 if tracker is not None:
                     bundle["tracker"] = tracker.gather(state)

@@ -93,22 +93,26 @@ class QueuePressureError(RuntimeError):
 
 
 def pressure_bundle(exc: QueuePressureError, *, diag_dir: str,
-                    label: str) -> str:
-    """Write the strict-mode diagnostic bundle (exit code 76)."""
-    return write_diagnostic_bundle(
-        diag_dir, label, "pressure",
-        {
-            "reason": "queue pressure under --overflow strict",
-            "would_drop": exc.drops,
-            "capacity": exc.capacity,
-            "progress": exc.summary,
-            "remedy": (
-                "rerun with a larger --capacity, or --overflow spill "
-                "(lossless) / grow (auto-resize) / drop (lossy, counted)"
-            ),
-            "exit_code": EXIT_PRESSURE,
-        },
-    )
+                    label: str, extra: dict | None = None) -> str:
+    """Write the strict-mode diagnostic bundle (exit code 76).
+
+    `extra` lets the driver attach context beyond the exception itself
+    — notably the flight-recorder ring, so the bundle carries the run's
+    recent heartbeat history alongside the pressure snapshot."""
+    payload = {
+        "reason": "queue pressure under --overflow strict",
+        "would_drop": exc.drops,
+        "capacity": exc.capacity,
+        "progress": exc.summary,
+        "remedy": (
+            "rerun with a larger --capacity, or --overflow spill "
+            "(lossless) / grow (auto-resize) / drop (lossy, counted)"
+        ),
+        "exit_code": EXIT_PRESSURE,
+    }
+    if extra:
+        payload.update(extra)
+    return write_diagnostic_bundle(diag_dir, label, "pressure", payload)
 
 
 def _unpack_words(packed: np.ndarray, n: int) -> list[np.ndarray]:

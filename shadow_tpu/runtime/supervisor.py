@@ -410,7 +410,14 @@ def run_with_retry(argv: list[str], *, retries: int,
     for attempt in range(retries + 1):
         report["attempts"] += 1
         first_out: list = [None]
-        proc = _popen(argv, start_new_session=True, stderr=subprocess.PIPE)
+        # the child's /healthz degrades with cause retry-relaunch-N
+        # when this is a relaunch rather than the first attempt
+        env = None
+        if attempt > 0:
+            env = dict(os.environ)
+            env["SHADOW_TPU_RETRY_ATTEMPT"] = str(attempt)
+        proc = _popen(argv, start_new_session=True, stderr=subprocess.PIPE,
+                      env=env)
 
         def _tee(stream, mark):
             for line in iter(stream.readline, b""):

@@ -523,6 +523,17 @@ class Simulation:
             out["overdue"] = snap.get("overdue", 0)
         return out
 
+    def metrics_refs(self, state) -> dict:
+        """Device-array refs for the live-telemetry extras (net drops,
+        fault drops, cross-shard traffic, socket byte totals) — the
+        reductions `HeartbeatHarvest` embeds in its bundle under
+        `--metrics`. Exposed here for the one-off fetch the CLI's
+        --overflow grow re-template path does after rebuilding (the
+        rebuilt harvest hasn't extracted yet at that boundary)."""
+        from shadow_tpu.obs.metrics import metrics_device_refs
+
+        return metrics_device_refs(state)
+
 
 def _plugin_tokens(cfg: ShadowConfig, plugin_id: str) -> set[str]:
     """Registry-matchable name tokens for a plugin: its id plus its path

@@ -1,0 +1,46 @@
+"""check_openmetrics: lint an OpenMetrics exposition for syntax errors.
+
+Thin CLI over `shadow_tpu.obs.metrics.validate_openmetrics` so shell
+harnesses (measure_all.sh's metrics_smoke stage) can gate on exporter
+output without a prometheus toolchain in the container. Reads a scrape
+from a file or stdin; prints one violation per line and exits 1 on any.
+
+Usage:
+    curl -s localhost:PORT/metrics | python -m \
+        shadow_tpu.tools.check_openmetrics -
+    python -m shadow_tpu.tools.check_openmetrics scrape.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from shadow_tpu.obs.metrics import validate_openmetrics
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="scrape file, or - for stdin")
+    args = ap.parse_args(argv)
+
+    if args.path == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.path) as f:
+            text = f.read()
+
+    problems = validate_openmetrics(text)
+    for p in problems:
+        print(p)
+    if not problems:
+        n = sum(
+            1 for ln in text.splitlines()
+            if ln and not ln.startswith("#")
+        )
+        print(f"ok: {n} samples", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -135,7 +135,8 @@ def export(in_path: str, out_path: str) -> dict:
         "displayTimeUnit": "ms",
         "otherData": {
             k: meta.get(k)
-            for k in ("n_records", "lost", "truncated", "seed", "tier")
+            for k in ("n_records", "lost", "truncated", "seed", "tier",
+                      "xprof_dir")
             if k in meta
         },
     }
@@ -144,7 +145,8 @@ def export(in_path: str, out_path: str) -> dict:
         f.write("\n")
     n_flows = sum(1 for e in events if e.get("ph") == "s")
     return {"events": len(events), "flows": n_flows,
-            "records": meta.get("n_records", 0), "out": out_path}
+            "records": meta.get("n_records", 0), "out": out_path,
+            "xprof_dir": meta.get("xprof_dir")}
 
 
 def main(argv=None) -> int:
@@ -165,6 +167,10 @@ def main(argv=None) -> int:
     print(f"wrote {stats['events']} trace events "
           f"({stats['records']} records, {stats['flows']} flow pairs) "
           f"-> {out}", file=sys.stderr)
+    if stats.get("xprof_dir"):
+        print(f"companion XLA profiler capture: {stats['xprof_dir']} "
+              "(open with xprof / tensorboard-plugin-profile)",
+              file=sys.stderr)
     return 0
 
 

@@ -60,6 +60,31 @@ PRESSURE_FIELDS = (
     "spill_lost", "reservoir_resident", "overdue", "harvest_seconds",
 )
 
+# whole-run [metrics] rows (only with --metrics): the telemetry
+# registry's CUMULATIVE totals — unlike the interval-delta sections
+# above, these columns match a live /metrics scrape and the end-of-run
+# summary directly (queue_fill is the 0..1 occupancy gauge)
+METRICS_FIELDS = (
+    "events", "queue_drops", "net_dropped", "fault_dropped",
+    "cross_shard_packets", "rx_bytes", "tx_bytes", "queue_fill",
+    "heartbeats",
+)
+
+
+def _sort_series(series: dict, key: str = "ticks") -> None:
+    """Stable-sort one tick-keyed column store in place. Heartbeat
+    sections are buffered independently (and a resumed or sharded run
+    may flush them interleaved), so consumers must not assume block
+    contiguity — normalize to tick order here, preserving emission
+    order within a tick."""
+    ticks = series.get(key)
+    if not ticks or all(a <= b for a, b in zip(ticks, ticks[1:])):
+        return
+    order = sorted(range(len(ticks)), key=ticks.__getitem__)
+    for k, col in series.items():
+        if isinstance(col, list) and len(col) == len(ticks):
+            series[k] = [col[i] for i in order]
+
 
 def parse_lines(lines) -> dict:
     nodes: dict[str, dict] = {}
@@ -72,6 +97,9 @@ def parse_lines(lines) -> dict:
     }
     pressure: dict[str, list] = {
         "ticks": [], **{f: [] for f in PRESSURE_FIELDS}
+    }
+    metrics: dict[str, list] = {
+        "ticks": [], **{f: [] for f in METRICS_FIELDS}
     }
     for line in lines:
         if "[shadow-heartbeat] [node] " in line:
@@ -166,9 +194,29 @@ def parse_lines(lines) -> dict:
                 float(parts[4]) if parts[4] else None
             )
             supervisor["checkpoints_written"].append(int(parts[5]))
+        elif "[shadow-heartbeat] [metrics] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [metrics] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 1 + len(METRICS_FIELDS):
+                continue
+            metrics["ticks"].append(int(parts[0]))
+            for f, v in zip(METRICS_FIELDS, parts[1:]):
+                metrics[f].append(
+                    float(v) if f == "queue_fill" else int(v)
+                )
+    # tolerate interleaved optional sections: logs from resumed/sharded
+    # runs (or concatenated shards) need not keep each section's rows
+    # contiguous or tick-ordered
+    for series in (supervisor, pressure, metrics):
+        _sort_series(series)
+    for per_name in (nodes, ram, faults, trace):
+        for series in per_name.values():
+            _sort_series(series)
+    for rows in sockets.values():
+        rows.sort(key=lambda r: r["time"])
     return {"nodes": nodes, "sockets": sockets, "ram": ram,
             "faults": faults, "trace": trace, "supervisor": supervisor,
-            "pressure": pressure}
+            "pressure": pressure, "metrics": metrics}
 
 
 def main(argv=None) -> int:

@@ -201,6 +201,49 @@ def make_figures(stats: dict, outdir: str, fmt: str = "png") -> list[str]:
         ax2.legend()
         save(fig, "shadow_tpu.pressure")
 
+    # 8. exporter-vs-tracker reconciliation — only with --metrics runs.
+    # The [metrics] rows are the telemetry registry's cumulative totals
+    # (what a live /metrics scrape returns); the [node] rows are the
+    # tracker's per-interval deltas. Summing the deltas must land on the
+    # registry curve at every heartbeat — any gap means the exporter and
+    # the heartbeat log disagree about the same run.
+    met = stats.get("metrics", {})
+    if met.get("ticks"):
+        fig, (ax, ax2) = plt.subplots(2, 1, figsize=(8, 6), sharex=True)
+        xs = met["ticks"]
+        ax.plot(xs, met.get("events", []), label="registry events",
+                color="tab:blue")
+        totals = {}
+        for node in nodes.values():
+            for t, d in zip(node.get("ticks", []),
+                            node.get("events_executed", [])):
+                totals[t] = totals.get(t, 0) + d
+        if totals:
+            txs, run, cum = sorted(totals), 0, []
+            for t in txs:
+                run += totals[t]
+                cum.append(run)
+            ax.plot(txs, cum, label="tracker cumulative", color="tab:orange",
+                    linestyle="--", marker="x")
+        ax.set_ylabel("events (cumulative)")
+        ax.set_title("exporter vs tracker reconciliation")
+        ax.legend()
+        gap = []
+        if totals and len(xs) == len(met.get("events", [])):
+            node_cum = {}
+            run = 0
+            for t in sorted(totals):
+                run += totals[t]
+                node_cum[t] = run
+            gap = [e - node_cum[t] for t, e in zip(xs, met["events"])
+                   if t in node_cum]
+        if gap:
+            ax2.plot(xs[: len(gap)], gap, color="tab:red")
+        ax2.axhline(0.0, color="grey", linewidth=0.8)
+        ax2.set_xlabel("sim time (s)")
+        ax2.set_ylabel("registry - tracker")
+        save(fig, "shadow_tpu.metrics")
+
     return written
 
 

@@ -223,7 +223,7 @@ class Tracker:
                  info_of: dict[str, tuple[str, ...]] | None = None,
                  level_of: dict[str, str] | None = None,
                  faults: Any = None, trace: Any = None,
-                 pressure: Any = None):
+                 pressure: Any = None, metrics: Any = None):
         self.names = names
         self.logger = logger
         self.log_info = log_info
@@ -234,6 +234,13 @@ class Tracker:
         # runtime.pressure.PressureController -> emit the [pressure]
         # section (cumulative snapshots diffed per interval, like prev)
         self.pressure = pressure
+        # obs.metrics.MetricsRegistry -> emit the [metrics] section: the
+        # exporter's *cumulative* totals (not interval deltas), so a
+        # live /metrics scrape, this row, and the end-of-run summary are
+        # directly comparable. The CLI loop ingests the fetched bundle
+        # into the registry before consume() runs this heartbeat, so
+        # the row and the [node] section describe the same extraction.
+        self.metrics = metrics
         self._prev_pressure: dict | None = None
         self.prev = Snapshot.zero(len(names))
         # None until the first heartbeat lands; afterwards the guard in
@@ -331,6 +338,11 @@ class Tracker:
             if self.pressure is not None:
                 self.logger.log(sim_ns, "tracker", "message",
                                 PRESSURE_HEADER)
+            if self.metrics is not None:
+                from shadow_tpu.obs.metrics import METRICS_HEADER
+
+                self.logger.log(sim_ns, "tracker", "message",
+                                METRICS_HEADER)
             self._emitted_headers = True
         t_s = sim_ns // 1_000_000_000
         p = self.prev
@@ -369,6 +381,12 @@ class Tracker:
             self._trace_lines(sim_ns, t_s)
         if self.pressure is not None and "pressure" in fetched:
             self._pressure_line(fetched["pressure"], sim_ns, t_s)
+        if self.metrics is not None:
+            self.logger.log(
+                sim_ns, "tracker", "message",
+                "[shadow-heartbeat] [metrics] "
+                + self.metrics.metrics_row(t_s),
+            )
         self.prev = cur
         self._prev_ns = sim_ns
 

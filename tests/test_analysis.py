@@ -367,6 +367,67 @@ def test_sl109_no_deadline_exemption_needs_reason():
     assert _rules(bare) == ["SL109"]
 
 
+def test_sl110_wallclock_in_jit():
+    fs = _lint("""
+        import time
+        import jax
+        @jax.jit
+        def f(x):
+            t0 = time.time()
+            t1 = time.perf_counter()
+            t2 = time.monotonic_ns()
+            return x + t0 + t1 + t2
+    """)
+    assert _rules(fs) == ["SL110"] and len(fs) == 3
+
+
+def test_sl110_silent_outside_jit():
+    # host-side wall clock is the supervisor/pressure idiom — never a
+    # finding outside jit scope (SL110 is about values freezing into
+    # compile-time constants, which only tracing can do)
+    fs = _lint("""
+        import time
+        def heartbeat():
+            return time.time(), time.monotonic()
+    """)
+    assert fs == []
+
+
+def test_sl110_from_import_and_bare_time():
+    # `from time import perf_counter` still trips inside jit; a bare
+    # `time(...)` call does NOT (too ambiguous — datetime.time, a local
+    # helper named time), only the module-attribute form is matched
+    fs = _lint("""
+        from time import perf_counter
+        import jax
+        @jax.jit
+        def f(x):
+            return x + perf_counter()
+    """)
+    assert _rules(fs) == ["SL110"]
+    fs = _lint("""
+        import jax
+        def time():
+            return 0
+        @jax.jit
+        def f(x):
+            return x + time()
+    """)
+    assert fs == []
+
+
+def test_sl110_inline_suppression():
+    fs = _lint("""
+        import time
+        import jax
+        @jax.jit
+        def f(x):
+            t = time.time()  # shadowlint: disable=SL110
+            return x + t
+    """)
+    assert fs == []
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng
