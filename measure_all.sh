@@ -94,6 +94,19 @@ timeout 1200 env JAX_PLATFORMS=cpu \
   --hlo-audit all --output measure_lint.json 2>> "$S" \
   && cat measure_lint.json >> "$R"
 echo "=== lint exit=$? $(date +%H:%M:%S)" >> "$S"
+# dataflow audit: the compiled-program gate — donation/aliasing over
+# every production window-loop jit, peak-live estimates vs the
+# checked-in MEM_BUDGETS.json, and the harvest host-transfer census
+# ("exactly one fetch per segment"). Refresh budgets deliberately with
+# `python -m shadow_tpu.tools.lint --mem-audit --update-baseline`.
+echo "=== dataflow_audit start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"dataflow_audit\"}" >> "$R"
+timeout 1200 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m shadow_tpu.tools.lint \
+  --donation-audit --mem-audit --output measure_dataflow.json 2>> "$S" \
+  && cat measure_dataflow.json >> "$R"
+echo "=== dataflow_audit exit=$? $(date +%H:%M:%S)" >> "$S"
 # sanitizer smoke: interposer + driver as one ASan/UBSan executable
 # (the dlmopen plugin path cannot host a sanitized DSO — see
 # shadow_tpu/proc/native.py SANITIZE_FLAGS)

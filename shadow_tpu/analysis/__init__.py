@@ -1,22 +1,32 @@
 """Static analysis for the jitted hot path (docs/10-Static-Analysis.md).
 
-Two layers, both importable without JAX side effects beyond what the
-package already does at import:
+Source-level and compiled-program layers; the lint layer is
+importable without JAX side effects beyond what the package already
+does at import:
 
 - `shadow_tpu.analysis.lint`: an AST linter flagging the JAX footguns
   that have historically cost this codebase debugging time (tracer
   branches, host materialization inside jit, i32 sim-time truncation,
-  PRNG key reuse, mutable default pytrees, unordered-iteration pytree
-  hazards), with a checked-in baseline so accepted findings don't
-  block the lint gate.
+  PRNG key reuse, donation misuse at the call site, mutable default
+  pytrees, unordered-iteration pytree hazards), with a checked-in
+  baseline so accepted findings don't block the lint gate.
+- `shadow_tpu.analysis.hlo_graph`: parses StableHLO pretty text into
+  a structural op graph (funcs, regions, defs/uses, bytes-per-shape)
+  — the substrate every audit below queries.
 - `shadow_tpu.analysis.hlo_audit`: lowers the engine for each model
-  config and checks the StableHLO text against declared contracts
-  (scatter budgets, custom-call allowlist, no host callbacks), plus
-  the centralized zero-cost check shared by the trace/pressure/faults
+  config and checks the op graph against declared contracts (scatter
+  budgets, custom-call allowlist, no host callbacks), plus the
+  centralized zero-cost check shared by the trace/pressure/faults
   test suites.
+- `shadow_tpu.analysis.donation`: compiles the production window-loop
+  jits and verifies from `input_output_alias` that every donated
+  carry leaf actually aliased, plus the harvest host-transfer census.
+- `shadow_tpu.analysis.memory`: peak-live-buffer estimates per config
+  from graph liveness, checked against `MEM_BUDGETS.json`.
 
 CLI: ``python -m shadow_tpu.tools.lint`` (JSON findings, baseline
-workflow, optional HLO audit).
+workflow, ``--hlo-audit`` / ``--donation-audit`` / ``--mem-audit`` /
+``--diff``).
 """
 
 from shadow_tpu.analysis.lint import (  # noqa: F401
@@ -36,4 +46,10 @@ from shadow_tpu.analysis.hlo_audit import (  # noqa: F401
     audit_model,
     audit_text,
     ops_histogram,
+)
+from shadow_tpu.analysis.hlo_graph import (  # noqa: F401
+    Module,
+    bytes_of_type,
+    dtype_bytes,
+    parse_module,
 )

@@ -1,0 +1,276 @@
+"""Donation/aliasing verifier + host-transfer census (post-compile).
+
+PR 6 threaded `donate_argnums` through every window-loop jit so the
+[H, C] queue arrays alias through instead of copying once per window.
+But donation is a *request*: XLA silently drops it when a leaf's
+layout, dtype, or sharding prevents aliasing — the program still
+answers correctly, it just pays a 2x memory tax nobody sees. This
+module compiles each production jit and reads the answer back from
+the compiled module's `input_output_alias` table:
+
+- `alias_params(text)` parses the aliased parameter numbers from the
+  compiled HLO header. XLA numbers parameters in the flattened-leaf
+  order of the jit's arguments *minus* the leaves jax's dead-argument
+  elimination dropped (`keep_unused=False` default; e.g. `.now` is
+  write-only in `step_window`, so it never becomes a parameter) — the
+  kept-leaf set comes from the lowering's `kept_var_idx`, so each
+  donated leaf maps to exactly one parameter number.
+- `audit_jit(jitted, args, label)` verifies every donated leaf
+  actually aliases; a dropped donation becomes a named violation
+  carrying the offending leaf path (e.g. ``args[0].queues.time``).
+  Donated-but-unused leaves (elided before XLA, so no copy can exist)
+  are reported separately, not failed.
+- `audit_all()` runs the production targets: the engine window loop
+  (`Engine.run`), the pressure path's `step_window` jit (what
+  `runtime.pressure.run_with_spill` builds), the harvest extraction
+  jits (full + light), and the sharded `Simulation._wrap` step over
+  an 8-device mesh (skipped, not failed, when fewer devices exist).
+- `transfer_census(text)` counts transfer-crossing ops
+  (infeed/outfeed/send/recv) in a compiled program; `census_all()`
+  applies it to the harvest extraction programs, pinning the "exactly
+  one host fetch per heartbeat segment" claim: the compiled segment
+  program crosses to host zero times, so the single `jax.device_get`
+  in `HeartbeatHarvest.fetch` is the segment's only transfer (the
+  runtime side is pinned in tests/test_dataflow.py).
+
+CLI: ``python -m shadow_tpu.tools.lint --donation-audit``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Iterable
+
+# `{output_index}: (param_number, {}, may-alias)` entries in the
+# `input_output_alias={ ... }` header of compiled HLO text.
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),")
+# Transfer-crossing op invocations in compiled HLO (op name directly
+# followed by its operand list — metadata strings never match).
+_TRANSFER_RE = re.compile(
+    r"\b(infeed|outfeed|send|recv|send-done|recv-done)\(")
+
+
+def alias_params(compiled_text: str) -> set[int]:
+    """Parameter numbers that alias an output in compiled HLO text."""
+    i = compiled_text.find("input_output_alias={")
+    if i < 0:
+        return set()
+    start = compiled_text.index("{", i)
+    depth, j = 0, start
+    while j < len(compiled_text):
+        if compiled_text[j] == "{":
+            depth += 1
+        elif compiled_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    table = compiled_text[start:j + 1]
+    return {int(p) for p in _ALIAS_ENTRY_RE.findall(table)}
+
+
+def _leaf_paths(args: tuple) -> list[str]:
+    """Flat-order leaf path strings over the call arguments."""
+    import jax
+
+    out: list[str] = []
+    for i, arg in enumerate(args):
+        for path, _leaf in jax.tree_util.tree_flatten_with_path(arg)[0]:
+            out.append(f"args[{i}]{jax.tree_util.keystr(path)}")
+    return out
+
+
+def transfer_census(compiled_text: str) -> dict[str, int]:
+    """Count transfer-crossing ops in compiled HLO text."""
+    counts: dict[str, int] = {}
+    for op in _TRANSFER_RE.findall(compiled_text):
+        counts[op] = counts.get(op, 0) + 1
+    return counts
+
+
+def audit_jit(jitted: Callable, args: tuple, label: str) -> dict:
+    """Compile `jitted(*args)` and verify every donated leaf aliases.
+
+    `jitted` must already carry its donate_argnums (the production
+    object is audited, not a reconstruction). Donation flags come from
+    the lowering's own per-leaf `args_info`; parameter numbers account
+    for jax's dead-argument elimination via `kept_var_idx` (a donated
+    leaf the jit dropped as unused never reaches XLA — no copy can
+    exist, so it is reported as `unused_leaves`, not failed). Returns
+    a report dict; `violations` names each donated-but-unaliased leaf
+    path.
+    """
+    import jax
+
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    text = compiled.as_text()
+    aliased = alias_params(text)
+    infos = jax.tree_util.tree_leaves(
+        lowered.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+    paths = _leaf_paths(args)
+    kept = getattr(getattr(lowered, "_lowering", None), "compile_args",
+                   {}).get("kept_var_idx")
+    if kept is None:  # private API moved: assume nothing was elided
+        kept = range(len(infos))
+    param_of = {flat: p for p, flat in enumerate(sorted(kept))}
+    violations: list[str] = []
+    unused: list[str] = []
+    n_donated = n_aliased = 0
+    for flat, info in enumerate(infos):
+        if not getattr(info, "donated", False):
+            continue
+        n_donated += 1
+        p = param_of.get(flat)
+        if p is None:
+            unused.append(paths[flat])
+            continue
+        if p in aliased:
+            n_aliased += 1
+        else:
+            violations.append(
+                f"{label}: donated leaf {paths[flat]} (parameter {p}) "
+                f"is NOT aliased in the compiled module — XLA dropped "
+                f"the donation; the buffer is copied every call")
+    report = {
+        "label": label,
+        "donated_leaves": n_donated,
+        "aliased_leaves": n_aliased,
+        "unused_leaves": unused,
+        "violations": violations,
+        "transfers": transfer_census(text),
+        "ok": not violations,
+    }
+    try:
+        ma = compiled.memory_analysis()
+        report["memory"] = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+        }
+    except Exception:
+        pass  # memory_analysis is backend-dependent; the alias table is not
+    return report
+
+
+def audit_fn(fn: Callable, args: tuple, donate_argnums, label: str) -> dict:
+    """Convenience: jit `fn` with the given donation and audit it."""
+    import jax
+
+    donated = ((donate_argnums,) if isinstance(donate_argnums, int)
+               else tuple(donate_argnums))
+    jitted = jax.jit(fn, donate_argnums=donated)
+    return audit_jit(jitted, args, label)
+
+
+# ------------------------------------------------------------- targets
+
+
+def _phold_tiny():
+    import jax.numpy as jnp
+
+    from shadow_tpu.models import phold
+
+    eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+    return eng, init(), jnp.int64(5_000_000_000)
+
+
+def _sim_tiny(**kw):
+    from shadow_tpu import examples
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.sim import build_simulation
+
+    text = examples.phold_example(8, msgs_per_host=2, stoptime=5)
+    return build_simulation(parse_config(text), seed=3, **kw)
+
+
+def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
+    """Audit the production window-loop jits. Each target compiles the
+    object the runtime actually calls:
+
+    - engine_run: jit(Engine.run, donate_argnums=0) — the unsharded
+      window loop (what Simulation._wrap builds for mesh=None).
+    - pressure_step: jit(Engine.step_window, donate_argnums=0) on a
+      spill-enabled build — runtime.pressure.run_with_spill's step.
+    - harvest_full / harvest_light: HeartbeatHarvest._build(full) —
+      the donating extraction jits the CLI heartbeat loop calls.
+    - sharded_step: Simulation._wrap(engine.run) over an 8-device
+      mesh (shard_map path) — skipped when fewer devices exist.
+    """
+    import jax.numpy as jnp
+
+    targets: dict[str, Callable[[], dict]] = {}
+
+    def engine_run() -> dict:
+        eng, st, stop = _phold_tiny()
+        return audit_fn(eng.run, (st, stop), 0, "engine_run")
+
+    def pressure_step() -> dict:
+        sim = _sim_tiny(overflow="spill", spill_len=64)
+        # the exact jit runtime.pressure.run_with_spill constructs
+        return audit_fn(sim.engine.step_window,
+                        (sim.state0, jnp.int64(sim.stop_ns)),
+                        0, "pressure_step")
+
+    def _harvest(full: bool) -> dict:
+        from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+        sim = _sim_tiny()
+        h = HeartbeatHarvest(sim)
+        label = "harvest_full" if full else "harvest_light"
+        return audit_jit(h._build(full), (sim.state0,), label)
+
+    def sharded_step() -> dict:
+        from shadow_tpu.parallel import mesh as pmesh
+
+        m = pmesh.make_mesh(8)  # RuntimeError when devices < 8 -> skip
+        sim = _sim_tiny(mesh=m)
+        jitted = sim._wrap(sim.engine.run)
+        return audit_jit(jitted, (sim.state0, jnp.int64(sim.stop_ns)),
+                         "sharded_step")
+
+    targets["engine_run"] = engine_run
+    targets["pressure_step"] = pressure_step
+    targets["harvest_full"] = lambda: _harvest(True)
+    targets["harvest_light"] = lambda: _harvest(False)
+    targets["sharded_step"] = sharded_step
+
+    out: dict[str, dict] = {}
+    for name in (names or sorted(targets)):
+        try:
+            out[name] = targets[name]()
+        except RuntimeError as e:
+            out[name] = {"label": name, "ok": True, "skipped": str(e),
+                         "violations": []}
+    return out
+
+
+def census_all() -> dict[str, Any]:
+    """Transfer census over the compiled harvest segment programs.
+
+    The heartbeat contract is "exactly one host fetch per segment":
+    the compiled extraction program must cross to host zero times
+    (every transfer op counted here is a violation), leaving the
+    single `jax.device_get` in HeartbeatHarvest.fetch as the
+    segment's only device->host transfer. The runtime single-fetch
+    pin lives in tests/test_dataflow.py.
+    """
+    from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+    sim = _sim_tiny()
+    h = HeartbeatHarvest(sim)
+    out: dict[str, Any] = {"fetches_per_segment": 1, "ok": True,
+                           "violations": []}
+    for full in (True, False):
+        name = "harvest_full" if full else "harvest_light"
+        text = h._build(full).lower(sim.state0).compile().as_text()
+        counts = transfer_census(text)
+        out[name] = {"transfer_ops": counts}
+        if counts:
+            out["ok"] = False
+            out["violations"].append(
+                f"{name}: compiled extraction program crosses to host "
+                f"({counts}) — the segment must fetch exactly once, "
+                f"through HeartbeatHarvest.fetch")
+    return out

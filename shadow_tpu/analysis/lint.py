@@ -53,6 +53,15 @@ way those disciplines have been (or nearly were) broken:
   of the compiled program sees the clock of its first trace. Wall
   timing belongs on host around the jit (``obs.WindowProfiler``); a
   timestamp a kernel needs must be threaded in as an argument.
+- SL111 donation misuse at the call site — the two ways
+  ``donate_argnums`` silently goes wrong in *caller* code: passing the
+  same array object to two donated parameters of one jit call (XLA
+  aliases two outputs onto one buffer — results corrupt), and reading
+  a Python reference again after it was passed to a donated position
+  (the donated buffer is deleted by the call; jax either errors or
+  silently re-copies, losing the donation). The fix is the engine's
+  own convention: immediately rebind the carry
+  (``state = step(state, stop)``) — rebinding clears the tracking.
 - SL108 collective call inside a ``while_loop``/``cond`` predicate —
   jax 0.4.x's experimental shard_map under ``check_rep=False``
   miscompiles collectives lowered into loop/branch predicates: device
@@ -87,6 +96,7 @@ RULES = {
     "SL108": "collective call inside a while_loop/cond predicate",
     "SL109": "blocking device sync outside watchdog-scoped sites",
     "SL110": "wall-clock read inside jit scope",
+    "SL111": "donated buffer double-donated or reused after donation",
 }
 
 # SL110: time-module entry points that read the wall clock. Bare-name
@@ -271,6 +281,11 @@ class _Linter(ast.NodeVisitor):
         self.func_params: dict[str, tuple[str, ...]] = {}
         # per-function PRNG use tracking: {keyname: [linenos]}
         self._prng_uses: list[dict[str, list[ast.Call]]] = [{}]
+        # SL111 per-function tracking: names bound to a donating
+        # jax.jit (name -> donated positions), and names whose buffer
+        # was consumed by a donated call (name -> consuming call)
+        self._donating: list[dict[str, set[int]]] = [{}]
+        self._donate_consumed: list[dict[str, ast.Call]] = [{}]
 
     # ------------------------------------------------------------ utils
 
@@ -356,9 +371,13 @@ class _Linter(ast.NodeVisitor):
         self.scopes.append(_Scope(node.name, jitted, params,
                                   predicate=node.name in self.pred_marked))
         self._prng_uses.append({})
+        self._donating.append({})
+        self._donate_consumed.append({})
         self.generic_visit(node)
         self._flush_prng()
         self._prng_uses.pop()
+        self._donating.pop()
+        self._donate_consumed.pop()
         self.scopes.pop()
 
     visit_FunctionDef = _visit_funcdef
@@ -390,8 +409,12 @@ class _Linter(ast.NodeVisitor):
                            f"use dataclasses.field(default_factory=...)")
         self.scopes.append(_Scope(node.name, False, set()))
         self._prng_uses.append({})
+        self._donating.append({})
+        self._donate_consumed.append({})
         self.generic_visit(node)
         self._prng_uses.pop()
+        self._donating.pop()
+        self._donate_consumed.pop()
         self.scopes.pop()
 
     @staticmethod
@@ -476,7 +499,14 @@ class _Linter(ast.NodeVisitor):
         # SL104: collect PRNG consumer uses
         self._track_prng(node)
 
+        # SL111: donation hazards at the call site. Consumption is
+        # registered only AFTER the call's own arguments are visited,
+        # so the consuming call never flags itself.
+        consumed = self._check_donate_call(node)
+
         self.generic_visit(node)
+        for name in consumed:
+            self._donate_consumed[-1].setdefault(name, node)
 
     @staticmethod
     def _is_wallclock_call(node: ast.Call) -> bool:
@@ -545,6 +575,82 @@ class _Linter(ast.NodeVisitor):
             f"carry is copied every call; donate it (see "
             f"Simulation._wrap) or mark the line "
             f"`# shadowlint: no-donate=<reason>`")
+
+    # ------------------------------------------------ SL111 donation use
+
+    @staticmethod
+    def _jit_donate_positions(call: ast.Call) -> set[int] | None:
+        """Donated positions of a `jax.jit(...)` call expression, or
+        None when it isn't one (or they aren't literal ints)."""
+        if _call_basename(call.func) != "jit":
+            return None
+        if isinstance(call.func, ast.Attribute) \
+                and _attr_root(call.func) != "jax":
+            return None
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out: set[int] = set()
+                for el in v.elts:
+                    if not (isinstance(el, ast.Constant)
+                            and isinstance(el.value, int)):
+                        return None
+                    out.add(el.value)
+                return out or None
+            return None
+        return None
+
+    def _check_donate_call(self, node: ast.Call) -> list[str]:
+        """SL111 at a call site. Returns Name args consumed by
+        donation (the caller registers them after generic_visit)."""
+        pos: set[int] | None = None
+        if isinstance(node.func, ast.Name):
+            for frame in reversed(self._donating):
+                if node.func.id in frame:
+                    pos = frame[node.func.id]
+                    break
+        elif isinstance(node.func, ast.Call):
+            # direct form: jax.jit(f, donate_argnums=0)(state, ...)
+            pos = self._jit_donate_positions(node.func)
+        if not pos:
+            return []
+        callee = _unparse(node.func)
+        by_name: dict[str, list[int]] = {}
+        for p in sorted(pos):
+            if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                by_name.setdefault(node.args[p].id, []).append(p)
+        for name, ps in by_name.items():
+            if len(ps) >= 2:
+                self._emit(
+                    "SL111", node,
+                    f"`{name}` fills donated parameters "
+                    f"{' and '.join(map(str, ps))} of `{callee}` in one "
+                    f"call — XLA aliases two outputs onto one buffer "
+                    f"and the results silently corrupt; pass distinct "
+                    f"arrays")
+        return list(by_name)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            first = self._donate_consumed[-1].get(node.id)
+            if first is not None:
+                self._emit(
+                    "SL111", node,
+                    f"`{node.id}` was donated to `{_unparse(first.func)}` "
+                    f"at line {first.lineno} and is read again — the "
+                    f"donated buffer is deleted by that call (jax errors "
+                    f"or silently re-copies); rebind the result "
+                    f"(`{node.id} = ...`) or pass a copy")
+        else:
+            # Store/Del rebinds the reference to a fresh buffer (for
+            # targets, with-as, del) — clear the tracking
+            self._donate_consumed[-1].pop(node.id, None)
+            self._donating[-1].pop(node.id, None)
+        self.generic_visit(node)
 
     # --------------------------------------------- SL108 pred collective
 
@@ -706,6 +812,20 @@ class _Linter(ast.NodeVisitor):
                 if isinstance(sub, ast.Name):
                     self._prng_uses[-1].pop(sub.id, None)
         self.generic_visit(node)
+        # SL111: a rebound name is a fresh buffer — clear AFTER the
+        # value was visited, so `st = step(st, stop)` first registers
+        # st as consumed (by the call) and then immediately clears it;
+        # a binding to a donating jax.jit becomes a tracked callee
+        tgt_names = [sub.id for tgt in node.targets
+                     for sub in ast.walk(tgt)
+                     if isinstance(sub, ast.Name)]
+        for n in tgt_names:
+            self._donate_consumed[-1].pop(n, None)
+            self._donating[-1].pop(n, None)
+        if isinstance(node.value, ast.Call) and len(tgt_names) == 1:
+            pos = self._jit_donate_positions(node.value)
+            if pos:
+                self._donating[-1][tgt_names[0]] = pos
 
     # -------------------------------------------------------- SL104 PRNG
 

@@ -1,0 +1,218 @@
+"""Peak-live-buffer estimator over the lowered op graph, with budgets.
+
+ROADMAP item 3 vmaps the whole engine over scenario fleets; before
+that lands, peak device memory per config needs a regression net the
+same way op counts have one. This module walks the parsed StableHLO
+graph (`hlo_graph.parse_module`) and computes a deterministic
+peak-live estimate per model config:
+
+- values expire at their last use *before* an op's regions execute
+  (XLA donates while-loop inputs through the carry, so the loop
+  operands and the iterArg carry never coexist);
+- an op's results materialize after its regions complete;
+- a region's own peak (its carry plus its temporaries) is charged at
+  the program point of the op that owns it; `func.call` charges the
+  callee's peak (memoized) the same way;
+- dead results (defined, never read) are charged at their definition
+  point only.
+
+This is an estimate of the *lowered* program, not a buffer-assignment
+readback: XLA's scheduler can do better (rematerialization, fusion)
+and the estimate deliberately ignores donation of the entry args (so
+it upper-bounds). What matters is that it is deterministic and moves
+when the carried state or the window loop's temporaries move — the
+checked-in budgets in `MEM_BUDGETS.json` turn that movement into a
+review-visible diff instead of a silent 2x on real silicon.
+
+Budgets cover the five model configs plus `phold_fleet` — the raw
+PHOLD engine vmapped over a 4-scenario fleet axis — so item-3 scaling
+regressions are caught before the fleet harness exists. Refresh with
+``python -m shadow_tpu.tools.lint --mem-audit --update-baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+from shadow_tpu.analysis import hlo_graph
+from shadow_tpu.analysis.hlo_graph import Func, Module, Op, Region
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MEM_BUDGETS.json")
+
+# The fleet axis the phold_fleet entry vmaps over: small enough to
+# lower fast, big enough that a per-scenario term shows up as 4x.
+FLEET = 4
+
+MEM_CONFIGS = ("phold", "phold_net", "tgen", "tor", "bitcoin",
+               "phold_fleet")
+
+
+# ------------------------------------------------------------ liveness
+
+
+def _op_uses(op: Op) -> set[str]:
+    """Every value the op reads, including free uses inside its
+    regions (charged at the op's program point)."""
+    used = set(op.operands)
+    for r in op.regions:
+        for o in r.walk():
+            used.update(o.operands)
+    return used
+
+
+def _region_peak(region: Region, module: Module,
+                 memo: dict[str, int]) -> int:
+    """Peak bytes live inside `region`, including its block-arg carry."""
+    carry = sum(hlo_graph.bytes_of_type(t) for _, t in region.block_args)
+    uses_at: list[set[str]] = []
+    last: dict[str, int] = {}
+    for i, op in enumerate(region.ops):
+        u = _op_uses(op)
+        uses_at.append(u)
+        for v in u:
+            last[v] = i
+    running = carry
+    peak = running
+    live: dict[str, int] = {}
+    for i, op in enumerate(region.ops):
+        for v in uses_at[i]:
+            if last[v] == i and v in live:
+                running -= live.pop(v)
+        inner = 0
+        for r in op.regions:
+            inner = max(inner, _region_peak(r, module, memo))
+        if op.callee and op.callee in module.funcs:
+            inner = max(inner, _func_peak(module.funcs[op.callee],
+                                          module, memo))
+        peak = max(peak, running + inner)
+        rb = op.result_bytes()
+        if op.result is not None and rb:
+            running += rb
+            peak = max(peak, running)
+            if op.result in last:
+                live[op.result] = rb
+            else:
+                running -= rb  # dead value: charged at its def only
+    return peak
+
+
+def _func_peak(func: Func, module: Module, memo: dict[str, int]) -> int:
+    if func.name in memo:
+        return memo[func.name]
+    memo[func.name] = 0  # recursion guard (MLIR funcs don't recurse)
+    peak = func.arg_bytes() + _region_peak(func.body, module, memo)
+    memo[func.name] = peak
+    return peak
+
+
+def estimate_module(module: Module) -> dict:
+    """Peak/carry/arg byte estimate for a parsed module's entry func."""
+    entry = module.entry
+    if entry is None:
+        return {"args_bytes": 0, "carry_bytes": 0, "peak_bytes": 0}
+    carry = 0
+    for op in entry.walk():
+        if op.short == "while":
+            carry = sum(hlo_graph.bytes_of_type(t)
+                        for t in op.result_types)
+            break  # outermost while = the window loop's carried state
+    return {
+        "args_bytes": entry.arg_bytes(),
+        "carry_bytes": carry,
+        "peak_bytes": _func_peak(entry, module, {}),
+    }
+
+
+def estimate_text(text: str) -> dict:
+    return estimate_module(hlo_graph.parse_module(text))
+
+
+# ------------------------------------------------------------- configs
+
+
+def _build_fleet():
+    """The raw PHOLD engine vmapped over a FLEET-wide scenario axis —
+    the lowering shape ROADMAP item 3 will run, estimated before it
+    lands."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.models import phold
+
+    eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+    st = init()
+    fleet_st = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((FLEET,) + x.shape, x.dtype), st)
+    vrun = jax.vmap(eng.run, in_axes=(0, None))
+    return vrun, fleet_st, jnp.int64(5_000_000_000)
+
+
+def estimate_config(name: str) -> dict:
+    """Lower one config's window loop and estimate its peak."""
+    from shadow_tpu.analysis import hlo_audit
+
+    if name == "phold_fleet":
+        run, state, stop = _build_fleet()
+    else:
+        run, state, stop = hlo_audit._build(name)
+    return estimate_text(hlo_audit.lower_text(run, state, stop))
+
+
+# ------------------------------------------------------------- budgets
+
+
+def load_budgets(path: str = BUDGETS_PATH) -> dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh).get("budgets", {})
+
+
+def save_budgets(estimates: dict[str, dict],
+                 path: str = BUDGETS_PATH) -> dict[str, dict]:
+    data = {
+        "version": 1,
+        "comment": "peak-live estimates per config (hlo_graph liveness "
+                   "over the lowered window loop); regenerate with "
+                   "`python -m shadow_tpu.tools.lint --mem-audit "
+                   "--update-baseline`",
+        "budgets": {k: estimates[k] for k in sorted(estimates)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1)
+        fh.write("\n")
+    return data["budgets"]
+
+
+def audit_all(names: Iterable[str] | None = None,
+              budgets: dict | None = None) -> dict[str, dict]:
+    """Estimate each config and check it against the checked-in
+    budgets. A config over budget, or missing from the budget file,
+    fails; an estimate *under* budget passes (improvements land
+    silently, `--diff` reports the drift)."""
+    budgets = load_budgets() if budgets is None else budgets
+    out: dict[str, dict] = {}
+    for name in (names or MEM_CONFIGS):
+        try:
+            est = estimate_config(name)
+        except RuntimeError as e:
+            out[name] = {"ok": True, "skipped": str(e),
+                         "violations": [], "estimate": {}}
+            continue
+        budget = budgets.get(name)
+        violations: list[str] = []
+        if budget is None:
+            violations.append(
+                f"{name}: no entry in MEM_BUDGETS.json — run "
+                f"--mem-audit --update-baseline to pin it")
+        elif est["peak_bytes"] > budget["peak_bytes"]:
+            violations.append(
+                f"{name}: peak-live estimate {est['peak_bytes']} bytes "
+                f"exceeds budget {budget['peak_bytes']} — the window "
+                f"loop grew; re-pin deliberately with --update-baseline")
+        out[name] = {"ok": not violations, "violations": violations,
+                     "estimate": est, "budget": budget}
+    return out

@@ -1,21 +1,31 @@
-"""shadowlint CLI — lint gate + HLO contract audit with JSON output.
+"""shadowlint CLI — lint gate + compiled-program audits, JSON output.
 
     python -m shadow_tpu.tools.lint                 # lint the package
     python -m shadow_tpu.tools.lint path/to/file.py # lint specific files
     python -m shadow_tpu.tools.lint --update-baseline
     python -m shadow_tpu.tools.lint --hlo-audit all # + lowering audit
     python -m shadow_tpu.tools.lint --hlo-audit phold,tgen
+    python -m shadow_tpu.tools.lint --donation-audit # alias verifier
+    python -m shadow_tpu.tools.lint --mem-audit      # peak-live budgets
+    python -m shadow_tpu.tools.lint --mem-audit --update-baseline
+    python -m shadow_tpu.tools.lint --diff old.json new.json
 
 Exit status: 0 when there are no findings outside the checked-in
-baseline (and, with --hlo-audit, every audited config meets its
-contract); 1 otherwise. Output is a single JSON document on stdout —
-machine-readable for the measure_all.sh lint stage — with human
-one-liners on stderr.
+baseline (and, with --hlo-audit / --donation-audit / --mem-audit,
+every audited config meets its contract); 1 otherwise. Output is a
+single JSON document on stdout — machine-readable for the
+measure_all.sh lint and dataflow_audit stages — with human one-liners
+on stderr.
 
 The baseline (shadow_tpu/analysis/lint_baseline.json) holds accepted
 findings keyed by (rule | path | function | source line) so they
 survive line drift; stale entries are reported (not fatal) so the
-baseline shrinks as findings are fixed. See docs/10-Static-Analysis.md.
+baseline shrinks as findings are fixed. `--mem-audit
+--update-baseline` refreshes the peak-live budgets
+(shadow_tpu/analysis/MEM_BUDGETS.json) the same way. `--diff`
+compares two saved JSON reports and prints the per-config drift of op
+budgets, alias counts, and memory estimates — the review artifact for
+an intentional budget bump. See docs/10-Static-Analysis.md.
 """
 
 from __future__ import annotations
@@ -25,6 +35,37 @@ import json
 import sys
 
 from shadow_tpu.analysis import lint as L
+
+
+def _diff_reports(old: dict, new: dict) -> list[str]:
+    """Human-readable per-config drift between two saved reports."""
+    lines: list[str] = []
+
+    def _num(section: str, cfg: str, key: str, a, b) -> None:
+        if a != b and isinstance(a, (int, float)) \
+                and isinstance(b, (int, float)):
+            lines.append(f"{section} {cfg}: {key} {a} -> {b} ({b - a:+d})")
+
+    oh, nh = old.get("hlo_audit", {}), new.get("hlo_audit", {})
+    for cfg in sorted(set(oh) | set(nh)):
+        oo = oh.get(cfg, {}).get("ops", {})
+        no = nh.get(cfg, {}).get("ops", {})
+        for op in sorted(set(oo) | set(no)):
+            _num("ops", cfg, op, oo.get(op, 0), no.get(op, 0))
+
+    od, nd = old.get("donation_audit", {}), new.get("donation_audit", {})
+    for tgt in sorted(set(od) | set(nd)):
+        for key in ("donated_leaves", "aliased_leaves"):
+            _num("donation", tgt, key,
+                 od.get(tgt, {}).get(key, 0), nd.get(tgt, {}).get(key, 0))
+
+    om, nm = old.get("mem_audit", {}), new.get("mem_audit", {})
+    for cfg in sorted(set(om) | set(nm)):
+        oe = om.get(cfg, {}).get("estimate", {})
+        ne = nm.get(cfg, {}).get("estimate", {})
+        for key in ("args_bytes", "carry_bytes", "peak_bytes"):
+            _num("memory", cfg, key, oe.get(key, 0), ne.get(key, 0))
+    return lines
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -43,9 +84,32 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--hlo-audit", metavar="CONFIGS", default=None,
                     help="also lower + audit model configs: 'all' or a "
                          "comma list of phold,phold_net,tgen,tor,bitcoin")
+    ap.add_argument("--donation-audit", action="store_true",
+                    help="compile the production window-loop jits and "
+                         "verify every donated leaf aliases; also runs "
+                         "the harvest host-transfer census")
+    ap.add_argument("--mem-audit", action="store_true",
+                    help="estimate peak-live bytes per config and check "
+                         "against MEM_BUDGETS.json")
+    ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                    default=None,
+                    help="compare two saved JSON reports and print the "
+                         "contract drift (report-only; exit 0)")
     ap.add_argument("--output", default=None,
                     help="write the JSON report here instead of stdout")
     args = ap.parse_args(argv)
+
+    if args.diff:
+        with open(args.diff[0], "r", encoding="utf-8") as fh:
+            old = json.load(fh)
+        with open(args.diff[1], "r", encoding="utf-8") as fh:
+            new_rep = json.load(fh)
+        lines = _diff_reports(old, new_rep)
+        for ln in lines:
+            print(ln)
+        if not lines:
+            print("no contract drift")
+        return 0
 
     findings = L.lint_paths(args.paths) if args.paths else L.lint_package()
 
@@ -54,6 +118,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"baseline: {len(entries)} keys "
               f"({len(findings)} findings) -> {args.baseline}",
               file=sys.stderr)
+        if args.mem_audit:
+            from shadow_tpu.analysis import memory as M
+
+            ests = {}
+            for name in M.MEM_CONFIGS:
+                try:
+                    ests[name] = M.estimate_config(name)
+                except RuntimeError as e:
+                    print(f"mem baseline: {name} skipped ({e})",
+                          file=sys.stderr)
+            M.save_budgets(ests)
+            print(f"mem baseline: {len(ests)} budgets -> "
+                  f"{M.BUDGETS_PATH}", file=sys.stderr)
         return 0
 
     baseline = {} if args.no_baseline else L.load_baseline(args.baseline)
@@ -82,6 +159,34 @@ def main(argv: list[str] | None = None) -> int:
                 failed = True
                 for v in res["violations"]:
                     print(f"hlo_audit: {v}", file=sys.stderr)
+
+    if args.donation_audit:
+        from shadow_tpu.analysis import donation as D
+
+        don = D.audit_all()
+        census = D.census_all()
+        report["donation_audit"] = don
+        report["transfer_census"] = census
+        for name, res in don.items():
+            if not res["ok"]:
+                failed = True
+                for v in res["violations"]:
+                    print(f"donation_audit: {v}", file=sys.stderr)
+        if not census["ok"]:
+            failed = True
+            for v in census["violations"]:
+                print(f"transfer_census: {v}", file=sys.stderr)
+
+    if args.mem_audit:
+        from shadow_tpu.analysis import memory as M
+
+        mem = M.audit_all()
+        report["mem_audit"] = mem
+        for name, res in mem.items():
+            if not res["ok"]:
+                failed = True
+                for v in res["violations"]:
+                    print(f"mem_audit: {v}", file=sys.stderr)
 
     for f in new:
         print(str(f), file=sys.stderr)

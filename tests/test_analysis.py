@@ -428,6 +428,67 @@ def test_sl110_inline_suppression():
     assert fs == []
 
 
+def test_sl111_double_donate_same_array():
+    fs = _lint("""
+        import jax
+        def f(step, a):
+            step2 = jax.jit(step, donate_argnums=(0, 1))
+            return step2(a, a)
+    """)
+    assert _rules(fs) == ["SL111"] and len(fs) == 1
+    assert "donated parameters 0 and 1" in fs[0].message
+
+
+def test_sl111_reuse_after_donation():
+    # reading a reference after it was passed to a donated position —
+    # the buffer is deleted by the call; both the named-jit and the
+    # direct jax.jit(...)(...) forms are tracked
+    fs = _lint("""
+        import jax
+        def g(step, st, stop):
+            jstep = jax.jit(step, donate_argnums=0)
+            out = jstep(st, stop)
+            return out, st.now
+    """)
+    assert _rules(fs) == ["SL111"] and len(fs) == 1
+    assert "`st` was donated" in fs[0].message
+    fs = _lint("""
+        import jax
+        def k(step, st, stop):
+            out = jax.jit(step, donate_argnums=0)(st, stop)
+            return st + out
+    """)
+    assert _rules(fs) == ["SL111"]
+
+
+def test_sl111_rebind_is_clean():
+    # the engine convention — st = step(st, stop) — rebinds the name
+    # to the jit's output, so later reads are fresh buffers; the
+    # run_with_spill window loop is exactly this shape
+    fs = _lint("""
+        import jax
+        def h(step, st, stop):
+            jstep = jax.jit(step, donate_argnums=0)
+            while int(st.now) < int(stop):
+                st = jstep(st, stop)
+            return st.now
+    """)
+    assert fs == []
+
+
+def test_sl111_undonated_calls_untracked():
+    # a jit without donate_argnums consumes nothing (SL107 owns the
+    # should-it-donate question for entry points)
+    fs = _lint("""
+        import jax
+        def f(fn, x):
+            j = jax.jit(fn)
+            y = j(x)
+            return x + y
+    """)
+    assert fs == []
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng

@@ -1,0 +1,224 @@
+"""Compiled-program dataflow audits (donation, memory, transfers).
+
+The donation verifier reads XLA's `input_output_alias` answer back for
+every production window-loop jit — including the dead-argument
+subtlety (`.now` is write-only in `step_window`, so jit elides it
+before XLA; no buffer exists, so no violation). A deliberately broken
+donation (dtype flip) must be caught with the offending leaf path
+named. The memory estimator is pinned on a hand-computed module and
+the checked-in MEM_BUDGETS.json; the harvest census is pinned both
+statically (zero transfer ops in the compiled extraction program) and
+at runtime (exactly one jax.device_get per heartbeat segment).
+"""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shadow_tpu.analysis import donation as D
+from shadow_tpu.analysis import memory as M
+
+
+# ------------------------------------------------------------- donation
+
+
+def test_production_jits_every_donated_leaf_aliases():
+    """The acceptance pin: engine step, pressure step, and both
+    harvest extraction jits — every donated leaf either aliases in
+    the compiled module or was elided as unused before XLA."""
+    rep = D.audit_all(["engine_run", "pressure_step",
+                       "harvest_full", "harvest_light"])
+    for name, r in rep.items():
+        assert r["ok"], (name, r["violations"])
+        if "skipped" in r:
+            continue
+        assert r["donated_leaves"] > 0
+        assert (r["aliased_leaves"] + len(r["unused_leaves"])
+                == r["donated_leaves"]), (name, r)
+    # step_window never reads st.now (both cond branches overwrite
+    # it), so jit drops the leaf — elided, not a dropped donation
+    assert rep["pressure_step"]["unused_leaves"] == ["args[0].now"]
+    assert rep["engine_run"]["unused_leaves"] == []
+
+
+def test_sharded_step_donation_holds_or_skips():
+    (r,) = D.audit_all(["sharded_step"]).values()
+    assert r["ok"], r["violations"]
+    if "skipped" not in r:
+        assert r["aliased_leaves"] == r["donated_leaves"]
+
+
+def test_broken_donation_names_the_leaf():
+    # flip one leaf's dtype across the call: XLA cannot alias i64->i32,
+    # the donation drops, and the audit must name exactly that leaf
+    def step(st, n):
+        return {"a": st["a"] + n, "b": (st["b"] + 1).astype(jnp.int32)}
+
+    st = {"a": jnp.zeros((64,), jnp.int64),
+          "b": jnp.zeros((64,), jnp.int64)}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # jax's own donation warning
+        rep = D.audit_fn(step, (st, jnp.int64(1)), 0, "broken")
+    assert not rep["ok"]
+    assert len(rep["violations"]) == 1
+    assert "args[0]['b']" in rep["violations"][0]
+    assert rep["aliased_leaves"] == 1  # 'a' still aliases
+
+
+def test_alias_params_parses_compiled_header():
+    text = """\
+HloModule jit_f, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, may-alias) }
+
+ENTRY main { ... }
+"""
+    assert D.alias_params(text) == {0, 2}
+    assert D.alias_params("HloModule jit_f\n") == set()
+
+
+def test_transfer_census_counts_ops():
+    text = ("  infeed(token[]) ...\n  outfeed(f32[2]{0} ...\n"
+            "  send(s32[] ...\n  send-done(...\n")
+    assert D.transfer_census(text) == {
+        "infeed": 1, "outfeed": 1, "send": 1, "send-done": 1}
+    # metadata strings don't count
+    assert D.transfer_census('op_name="send_helper" recv_bytes=3') == {}
+
+
+def test_harvest_census_static():
+    cen = D.census_all()
+    assert cen["ok"], cen["violations"]
+    assert cen["fetches_per_segment"] == 1
+    assert cen["harvest_full"]["transfer_ops"] == {}
+    assert cen["harvest_light"]["transfer_ops"] == {}
+
+
+def test_harvest_runtime_one_fetch_per_segment(monkeypatch):
+    """The runtime half of the census: a heartbeat segment is one
+    extract (device-side, no sync) + one fetch (one device_get)."""
+    from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+    sim = D._sim_tiny()
+    h = HeartbeatHarvest(sim)
+    state = sim.state0
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get", lambda x: (calls.append(1), real(x))[1])
+    for full in (False, True, False):
+        state, bundle = h.extract(state, full=full)
+        before = len(calls)
+        h.fetch(bundle)
+        assert len(calls) == before + 1  # the segment's one transfer
+    assert len(calls) == 3
+
+
+# --------------------------------------------------------------- memory
+
+
+_EST_SIMPLE = """\
+module @est {
+  func.func public @main(%arg0: tensor<8xi64>) -> tensor<8xi64> {
+    %0 = stablehlo.add %arg0, %arg0 : tensor<8xi64>
+    %1 = stablehlo.multiply %0, %0 : tensor<8xi64>
+    return %1 : tensor<8xi64>
+  }
+}
+"""
+
+_EST_WHILE = """\
+module @est {
+  func.func public @main(%arg0: tensor<8xi64>) -> tensor<8xi64> {
+    %0 = stablehlo.while(%iterArg = %arg0) : tensor<8xi64>
+     cond {
+      %1 = stablehlo.slice %iterArg : (tensor<8xi64>) -> tensor<i1>
+      stablehlo.return %1 : tensor<i1>
+    } do {
+      %1 = stablehlo.add %iterArg, %iterArg : tensor<8xi64>
+      stablehlo.return %1 : tensor<8xi64>
+    }
+    return %0 : tensor<8xi64>
+  }
+}
+"""
+
+
+def test_estimator_hand_computed():
+    # args 64; %0 expires at its last use, so add/multiply never
+    # coexist: peak = args + one 64-byte temp
+    est = M.estimate_text(_EST_SIMPLE)
+    assert est == {"args_bytes": 64, "carry_bytes": 0, "peak_bytes": 128}
+    # while: do-region peak = 64 carry + 64 temp = 128, charged at the
+    # while's program point; carry read off the while result types
+    est = M.estimate_text(_EST_WHILE)
+    assert est == {"args_bytes": 64, "carry_bytes": 64,
+                   "peak_bytes": 192}
+
+
+def test_budgets_checked_in_for_all_configs():
+    budgets = M.load_budgets()
+    for name in M.MEM_CONFIGS:
+        assert name in budgets, name
+        assert budgets[name]["peak_bytes"] > 0
+    # the fleet-vmapped entry scales with the FLEET axis — the item-3
+    # regression net: a per-scenario term must show up as ~FLEET x
+    assert budgets["phold_fleet"]["peak_bytes"] > \
+        2 * budgets["phold"]["peak_bytes"]
+    assert budgets["phold_fleet"]["args_bytes"] == \
+        (budgets["phold"]["args_bytes"] - 8) * M.FLEET + 8
+
+
+def test_phold_estimate_meets_budget_and_missing_budget_fails():
+    est = M.estimate_config("phold")
+    budgets = M.load_budgets()
+    assert est["peak_bytes"] <= budgets["phold"]["peak_bytes"]
+    rep = M.audit_all(["phold"], budgets={})
+    assert not rep["phold"]["ok"]
+    assert "MEM_BUDGETS.json" in rep["phold"]["violations"][0]
+    over = {"phold": dict(budgets["phold"], peak_bytes=1)}
+    rep = M.audit_all(["phold"], budgets=over)
+    assert any("exceeds budget" in v for v in rep["phold"]["violations"])
+
+
+# ----------------------------------------------------------------- diff
+
+
+def test_diff_reports_drift():
+    from shadow_tpu.tools.lint import _diff_reports
+
+    old = {
+        "hlo_audit": {"phold": {"ops": {"scatter": 0, "sort": 3}}},
+        "donation_audit": {"engine_run": {"donated_leaves": 23,
+                                          "aliased_leaves": 23}},
+        "mem_audit": {"phold": {"estimate": {"peak_bytes": 100,
+                                             "args_bytes": 10,
+                                             "carry_bytes": 10}}},
+    }
+    new = json.loads(json.dumps(old))
+    new["hlo_audit"]["phold"]["ops"]["scatter"] = 2
+    new["donation_audit"]["engine_run"]["aliased_leaves"] = 20
+    new["mem_audit"]["phold"]["estimate"]["peak_bytes"] = 150
+    lines = _diff_reports(old, new)
+    assert any("scatter 0 -> 2 (+2)" in ln for ln in lines)
+    assert any("aliased_leaves 23 -> 20 (-3)" in ln for ln in lines)
+    assert any("peak_bytes 100 -> 150 (+50)" in ln for ln in lines)
+    assert len(lines) == 3
+    assert _diff_reports(old, old) == []
+
+
+def test_cli_diff_mode(tmp_path, capsys):
+    from shadow_tpu.tools import lint as cli
+
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(
+        {"mem_audit": {"tor": {"estimate": {"peak_bytes": 5}}}}))
+    b.write_text(json.dumps(
+        {"mem_audit": {"tor": {"estimate": {"peak_bytes": 9}}}}))
+    assert cli.main(["--diff", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "memory tor: peak_bytes 5 -> 9 (+4)" in out
+    assert cli.main(["--diff", str(a), str(a)]) == 0
+    assert "no contract drift" in capsys.readouterr().out

@@ -1,0 +1,205 @@
+"""StableHLO graph parser (shadow_tpu/analysis/hlo_graph.py).
+
+Two layers of round-trip: a synthetic module exercising every grammar
+form the parser claims (while cond/do regions, generic-form ops with
+^bb0 block args, func.call reachability, quoted custom_call targets,
+tuple-element uses), and the real lowered programs the audits run on
+(unsharded phold, sharded phold with GSPMD markers, the harvest
+extraction jit). Byte accounting is pinned per dtype and cross-checked
+against the compiled module's own memory analysis.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shadow_tpu.analysis import hlo_audit as H
+from shadow_tpu.analysis import hlo_graph as G
+
+
+# ------------------------------------------------------------ byte math
+
+
+def test_dtype_bytes_engine_dtypes():
+    # every dtype the engine's pytrees carry, plus the narrow/wide ends
+    assert G.dtype_bytes("i1") == 1
+    assert G.dtype_bytes("pred") == 1
+    assert G.dtype_bytes("i8") == 1
+    assert G.dtype_bytes("i16") == 2
+    assert G.dtype_bytes("i32") == 4
+    assert G.dtype_bytes("i64") == 8
+    assert G.dtype_bytes("ui8") == 1
+    assert G.dtype_bytes("ui32") == 4
+    assert G.dtype_bytes("ui64") == 8
+    assert G.dtype_bytes("f16") == 2
+    assert G.dtype_bytes("bf16") == 2
+    assert G.dtype_bytes("f32") == 4
+    assert G.dtype_bytes("f64") == 8
+    assert G.dtype_bytes("c64") == 8
+    assert G.dtype_bytes("c128") == 16
+
+
+def test_bytes_of_type():
+    assert G.bytes_of_type("tensor<i64>") == 8
+    assert G.bytes_of_type("tensor<8x32xi32>") == 8 * 32 * 4
+    assert G.bytes_of_type("tensor<4x0xi64>") == 0
+    assert G.bytes_of_type("tensor<8xi1>") == 8
+    # encoding attributes after the comma don't change the payload
+    assert G.bytes_of_type(
+        "tensor<8xi64, #stablehlo.type_extensions<bounds = [4]>>") == 64
+    # non-tensor types carry no buffer
+    assert G.bytes_of_type("!stablehlo.token") == 0
+
+
+# ---------------------------------------------------- synthetic module
+
+
+_SYNTH = """\
+module @jit_run attributes {mhlo.num_partitions = 1 : i32} {
+  func.func public @main(%arg0: tensor<i64>, %arg1: tensor<8x4xi64>) -> (tensor<i64> {jax.result_info = ".now"}, tensor<8x4xi64>) {
+    %c = stablehlo.constant dense<0> : tensor<i64>
+    %0:2 = stablehlo.while(%iterArg = %arg0, %iterArg_0 = %arg1) : tensor<i64>, tensor<8x4xi64>
+     cond {
+      %1 = stablehlo.compare  LT, %iterArg, %c : (tensor<i64>, tensor<i64>) -> tensor<i1>
+      stablehlo.return %1 : tensor<i1>
+    } do {
+      %1 = stablehlo.add %iterArg, %c : tensor<i64>
+      %2 = func.call @helper(%iterArg_0) : (tensor<8x4xi64>) -> tensor<8x4xi64>
+      %3 = stablehlo.custom_call @"annotate_device_placement"(%2) {has_side_effect = true} : (tensor<8x4xi64>) -> tensor<8x4xi64>
+      stablehlo.return %1, %3 : tensor<i64>, tensor<8x4xi64>
+    }
+    return %0#0, %0#1 : tensor<i64>, tensor<8x4xi64>
+  }
+  func.func private @helper(%arg0: tensor<8x4xi64>) -> tensor<8x4xi64> {
+    %0 = "stablehlo.sort"(%arg0) <{dimension = 1 : i64}> ({
+    ^bb0(%arg2: tensor<i64>, %arg3: tensor<i64>):
+      %1 = stablehlo.compare  LT, %arg2, %arg3 : (tensor<i64>, tensor<i64>) -> tensor<i1>
+      stablehlo.return %1 : tensor<i1>
+    }) : (tensor<8x4xi64>) -> tensor<8x4xi64>
+    return %0 : tensor<8x4xi64>
+  }
+  func.func private @dead(%arg0: tensor<f32>) -> tensor<f32> {
+    %0 = stablehlo.negate %arg0 : tensor<f32>
+    return %0 : tensor<f32>
+  }
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def synth():
+    return G.parse_module(_SYNTH)
+
+
+def test_funcs_and_entry(synth):
+    assert set(synth.funcs) == {"main", "helper", "dead"}
+    assert synth.entry.name == "main"
+    assert synth.entry.visibility == "public"
+    assert synth.funcs["helper"].visibility == "private"
+    # entry signature: names, types, and jax.result_info leaf paths
+    assert [n for n, _t, _a in synth.entry.args] == ["%arg0", "%arg1"]
+    assert synth.entry.arg_bytes() == 8 + 8 * 4 * 8
+    assert ".now" in synth.entry.result_infos
+
+
+def test_reachability_excludes_dead_funcs(synth):
+    names = {f.name for f in synth.reachable_funcs()}
+    assert names == {"main", "helper"}  # @dead is parsed but unreached
+    hist = synth.histogram()
+    assert "negate" not in hist  # dead-func ops don't count
+    assert G.parse_module(_SYNTH).histogram(
+        reachable_only=False)["negate"] == 1
+
+
+def test_histogram_counts_op_instances_once(synth):
+    hist = synth.histogram()
+    assert hist["while"] == 1
+    assert hist["sort"] == 1  # reached through func.call @helper
+    assert hist["custom_call"] == 1
+    assert hist["add"] == 1
+    # compare appears in the while cond AND the sort comparator
+    assert hist["compare"] == 2
+    # stablehlo.return is a dialect op (3 region terminators here);
+    # func.call / func.return are structural and never counted
+    assert hist["return"] == 3
+    assert "call" not in hist
+
+
+def test_region_nesting_and_carry(synth):
+    (w,) = synth.find_ops("while")
+    assert [r.label for r in w.regions] == ["cond", "do"]
+    # both while regions see the iterArg carry as block args
+    for r in w.regions:
+        assert [n for n, _t in r.block_args] == ["%iterArg", "%iterArg_0"]
+        assert [t for _n, t in r.block_args] == \
+            ["tensor<i64>", "tensor<8x4xi64>"]
+    (s,) = synth.find_ops("sort")
+    assert len(s.regions) == 1
+    assert [n for n, _t in s.regions[0].block_args] == ["%arg2", "%arg3"]
+    assert s.result_types == ["tensor<8x4xi64>"]
+    assert s.result_bytes() == 8 * 4 * 8
+
+
+def test_quoted_custom_call_target(synth):
+    # the quoted form `custom_call @"..."` the old regex missed
+    assert synth.custom_call_targets() == ["annotate_device_placement"]
+
+
+def test_tuple_element_uses(synth):
+    ret = [op for op in synth.entry.body.ops if op.short == "return"][0]
+    assert ret.operands == ["%0"]  # %0#0 / %0#1 resolve to base %0
+
+
+def test_loose_text_toplevel():
+    # bare op lines (no func wrapper) land in an implicit public func —
+    # the audit_text fixtures depend on this
+    m = G.parse_module("stablehlo.sort ...\nstablehlo.scatter ...\n")
+    assert m.entry is not None
+    assert m.histogram() == {"sort": 1, "scatter": 1}
+
+
+# -------------------------------------------------------- real programs
+
+
+def test_roundtrip_unsharded_phold():
+    run, state, stop = H._build("phold")
+    m = G.parse_module(H.lower_text(run, state, stop))
+    leaves = jax.tree_util.tree_leaves(state)
+    # entry args = every state leaf + stop, byte-exact
+    assert len(m.entry.args) == len(leaves) + 1
+    assert m.entry.arg_bytes() == sum(x.nbytes for x in leaves) + 8
+    hist = m.histogram()
+    assert hist["while"] >= 1 and hist["sort"] >= 1
+    assert hist.get("scatter", 0) == 0  # the phold contract, structurally
+    # the window loop's body is where the work is
+    assert sum(1 for _ in m.while_body_ops()) > 0
+
+
+def test_roundtrip_sharded_phold_gspmd():
+    try:
+        run, state, stop = H._build("phold_sharded")
+    except RuntimeError as e:
+        pytest.skip(str(e))
+    m = G.parse_module(H.lower_text(run, state, stop))
+    targets = set(m.custom_call_targets())
+    assert "Sharding" in targets  # GSPMD markers present...
+    allow = set(H.CONTRACTS["phold_sharded"].custom_call_allow)
+    assert targets <= allow  # ...and all on the allowlist
+    hist = m.histogram()
+    # the sharded contract, structurally: counts come from the
+    # reachable graph (shmap_body and its callees), not regex text
+    assert hist["all_to_all"] == 12 and hist["scatter"] == 14
+
+
+def test_roundtrip_harvest_program():
+    from shadow_tpu.analysis import donation as D
+    from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+    sim = D._sim_tiny()
+    h = HeartbeatHarvest(sim)
+    text = h._build(True).lower(sim.state0).as_text()
+    m = G.parse_module(text)
+    assert m.entry is not None and len(m.entry.result_infos) > 0
+    hist = m.histogram()
+    for op in ("infeed", "outfeed", "send", "recv"):
+        assert hist.get(op, 0) == 0  # extraction never crosses to host
