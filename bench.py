@@ -285,12 +285,17 @@ def tor_worker():
     stop_s = (20, 10, 10, 10)[tier_idx]
     stop_s = int(os.environ.get("BENCH_TOR_STOP_S", stop_s))
     _stamp(f"tor tier {relays}/{clients}/{servers} cpu={with_cpu}: building")
+    t_start = time.perf_counter()
     cfg = parse_config(tor_example(
         n_relays_per_class=relays, n_clients=clients,
         n_servers=servers, filesize="64KiB", count=2, stoptime=stop_s,
         relay_cpu_ghz=3.0 if with_cpu else 0.0,
     ))
     runahead_ms = float(os.environ.get("BENCH_RUNAHEAD_MS", 0))
+    # BENCH_FRONTIER > 0 selects the engine's frontier drain (the third
+    # drain contract, docs/11-Performance.md "Model-tier batching"):
+    # bit-identical results, per-event bookkeeping amortized per round
+    frontier = int(os.environ.get("BENCH_FRONTIER", 0))
     sim = _build_on_cpu(
         cfg, seed=1,
         # 32 sockets cover the worst role (a server carries ~23 conns:
@@ -301,12 +306,14 @@ def tor_worker():
         runahead_ns=(
             int(runahead_ms * MILLISECOND) if runahead_ms > 0 else None
         ),
+        frontier=frontier,
     )
     drain_b = int(os.environ.get("BENCH_DRAIN_B", 0))
     if drain_b:
         import dataclasses as _dc
         sim.engine.cfg = _dc.replace(sim.engine.cfg, drain_batch=drain_b)
     sim.strict_overflow = False
+    build_s = time.perf_counter() - t_start
     _stamp("build done; compiling + first chunk")
     # CHUNKED execution: one long device invocation trips the axon
     # tunnel's deadline and kills the whole program (UNAVAILABLE: TPU
@@ -319,6 +326,7 @@ def tor_worker():
     chunk_ns = max(int(chunk_s * SECOND), 1)
     st = sim.run(chunk_ns)
     jax.block_until_ready(st.now)
+    compile_s = time.perf_counter() - t_start - build_s
     _stamp("compile banked in .jax_cache; timed chunked run")
     stop_ns = stop_s * SECOND
     t0 = time.perf_counter()
@@ -356,6 +364,13 @@ def tor_worker():
         f"{pre}inner_steps": inner,
         f"{pre}events_per_sweep": round(n_events / max(sweeps, 1), 2),
         f"{pre}cpu_model": with_cpu,
+        f"{pre}frontier": frontier,
+        f"{pre}runahead_ms": runahead_ms,
+        f"{pre}profile": {
+            "build_s": round(build_s, 2),
+            "compile_s": round(compile_s, 2),
+            "run_s": round(wall, 2),
+        },
     }))
 
 
@@ -409,6 +424,81 @@ def tor_churn_worker():
         "torchurn_events": n_events,
         "torchurn_fault_drops": fault_drops,
         "torchurn_quarantined": quarantined,
+    }))
+
+
+def tgen_worker():
+    """Secondary metric: the pure-TCP TGen transfer workload (BASELINE
+    configs 1-2 shape scaled to BENCH_TGEN_PAIRS client/server pairs).
+    No relay crypto, no CPU model: this isolates the transport + model
+    tier the frontier drain batches, so the tgen_* chained-vs-frontier
+    pair prices the drain contract itself rather than the tor relay
+    pipeline on top of it. Same knobs as tor_worker: BENCH_FRONTIER
+    selects the frontier drain, BENCH_RUNAHEAD_MS widens windows."""
+    _enable_compile_cache()
+    import jax
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.timebase import MILLISECOND, SECOND
+    from shadow_tpu.examples import tgen_example
+    from shadow_tpu.sim import build_simulation
+
+    n_pairs = int(os.environ.get("BENCH_TGEN_PAIRS", 256))
+    stop_s = int(os.environ.get("BENCH_TGEN_STOP_S", 10))
+    runahead_ms = float(os.environ.get("BENCH_RUNAHEAD_MS", 0))
+    frontier = int(os.environ.get("BENCH_FRONTIER", 0))
+    _stamp(f"tgen {n_pairs} pairs: building")
+    t_start = time.perf_counter()
+    cfg = parse_config(tgen_example(
+        n_pairs=n_pairs, sendsize="16KiB", recvsize="64KiB", count=4,
+        stoptime=stop_s,
+    ))
+    sim = _build_on_cpu(
+        cfg, seed=1, n_sockets=8, capacity=768,
+        runahead_ns=(
+            int(runahead_ms * MILLISECOND) if runahead_ms > 0 else None
+        ),
+        frontier=frontier,
+    )
+    sim.strict_overflow = False
+    build_s = time.perf_counter() - t_start
+    _stamp("tgen build done; compiling + first chunk")
+    chunk_s = float(os.environ.get("BENCH_CHUNK_S", 1.0))
+    chunk_ns = max(int(chunk_s * SECOND), 1)
+    st = sim.run(chunk_ns)
+    jax.block_until_ready(st.now)
+    compile_s = time.perf_counter() - t_start - build_s
+    _stamp("tgen compile banked; timed chunked run")
+    stop_ns = stop_s * SECOND
+    t0 = time.perf_counter()
+    st = sim.run(chunk_ns)
+    k = 2 * chunk_ns
+    while k < stop_ns + chunk_ns:
+        st = sim.run(min(k, stop_ns), state=st)
+        k += chunk_ns
+    n_streams = int(jax.device_get(st.hosts.app.streams_done).sum())
+    n_events = int(jax.device_get(st.stats.n_executed).sum())
+    sweeps = int(jax.device_get(st.stats.n_sweeps))
+    inner = int(jax.device_get(st.stats.n_inner_steps))
+    windows = int(jax.device_get(st.stats.n_windows))
+    wall = time.perf_counter() - t0
+    _stamp(f"tgen timed run done in {wall:.2f}s")
+    print(json.dumps({
+        "tgen_hosts": len(sim.names),
+        "tgen_sim_s_per_wall_s": round(stop_s / max(wall, 1e-9), 3),
+        "tgen_streams_done": n_streams,
+        "tgen_events": n_events,
+        "tgen_windows": windows,
+        "tgen_sweeps": sweeps,
+        "tgen_inner_steps": inner,
+        "tgen_events_per_sweep": round(n_events / max(sweeps, 1), 2),
+        "tgen_frontier": frontier,
+        "tgen_runahead_ms": runahead_ms,
+        "tgen_profile": {
+            "build_s": round(build_s, 2),
+            "compile_s": round(compile_s, 2),
+            "run_s": round(wall, 2),
+        },
     }))
 
 
@@ -1070,6 +1160,27 @@ def perf_smoke():
     wall = time.perf_counter() - t0
     rate = executed / wall
 
+    # TCP-workload floor: a small tgen config under the FRONTIER drain
+    # (the TCP model tier's hot path since the model-tier batching PR).
+    # PHOLD gates the commutative batched drain; this gates the
+    # transport/handler pass + the frontier bookkeeping, which PHOLD's
+    # stateless handler never touches.
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.examples import tgen_example
+    from shadow_tpu.sim import build_simulation
+
+    tcp_pairs, tcp_stop_s = 16, 10
+    cfg = parse_config(tgen_example(n_pairs=tcp_pairs, stoptime=tcp_stop_s))
+    sim = build_simulation(cfg, seed=1, n_sockets=8, frontier=8)
+    sim.strict_overflow = False
+    tst = sim.run(1 * SECOND)  # compile
+    jax.block_until_ready(tst.now)
+    t0 = time.perf_counter()
+    tst = sim.run(tcp_stop_s * SECOND)
+    tcp_executed = int(jax.device_get(tst.stats.n_executed).sum())
+    tcp_wall = time.perf_counter() - t0
+    tcp_rate = tcp_executed / tcp_wall
+
     floor_path = os.path.join(_REPO, "PERF_FLOOR.json")
     try:
         with open(floor_path) as f:
@@ -1077,28 +1188,135 @@ def perf_smoke():
     except (OSError, json.JSONDecodeError):
         floor = {}
     if os.environ.get("PERF_SMOKE_UPDATE") == "1":
-        floor = {
+        # update measured floors in place — unrelated keys survive so
+        # the two gates can be re-floored independently
+        floor.update({
             "phold_cpu_events_per_s": round(rate, 1),
             "n_hosts": n_hosts, "stop_s": stop_s,
             "msgs_per_host": MSGS_PER_HOST, "capacity": CAPACITY,
-        }
+            "tgen_cpu_events_per_s": round(tcp_rate, 1),
+            "tgen_pairs": tcp_pairs, "tgen_stop_s": tcp_stop_s,
+            "tgen_frontier": 8,
+        })
         with open(floor_path, "w") as f:
             json.dump(floor, f, indent=2)
             f.write("\n")
     fl = float(floor.get("phold_cpu_events_per_s", 0.0))
+    tcp_fl = float(floor.get("tgen_cpu_events_per_s", 0.0))
     ok = fl <= 0 or rate >= 0.7 * fl
+    tcp_ok = tcp_fl <= 0 or tcp_rate >= 0.7 * tcp_fl
     print(json.dumps({
         "perf_smoke_events_per_s": round(rate, 1),
         "perf_smoke_floor": fl,
         "perf_smoke_events": executed,
         "perf_smoke_wall_s": round(wall, 3),
-        "perf_smoke_ok": ok,
+        "perf_smoke_tgen_events_per_s": round(tcp_rate, 1),
+        "perf_smoke_tgen_floor": tcp_fl,
+        "perf_smoke_tgen_events": tcp_executed,
+        "perf_smoke_tgen_wall_s": round(tcp_wall, 3),
+        "perf_smoke_ok": ok and tcp_ok,
     }), flush=True)
     if not ok:
         print(f"perf_smoke: {rate:.0f} events/s is below 70% of the "
               f"PERF_FLOOR.json floor {fl:.0f} — hot-path regression",
               file=sys.stderr)
+    if not tcp_ok:
+        print(f"perf_smoke: tgen {tcp_rate:.0f} events/s is below 70% "
+              f"of the PERF_FLOOR.json floor {tcp_fl:.0f} — TCP/frontier "
+              f"hot-path regression", file=sys.stderr)
+    if not (ok and tcp_ok):
         sys.exit(1)
+
+
+def previous_tor_record() -> tuple[str, dict]:
+    """(label, parsed) of the newest checked-in BENCH_r*.json whose
+    parsed dict carries tor_* keys — the anchor the tor_rt stage prints
+    its regression delta against. ("", {}) when none exists."""
+    import glob
+    import re
+
+    best = ("", {}, -1)
+    for path in glob.glob(os.path.join(_REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        n = int(m.group(1))
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, json.JSONDecodeError):
+            continue
+        if float(parsed.get("tor_sim_s_per_wall_s", 0.0)) > 0 and n > best[2]:
+            best = (f"r{n:02d}", parsed, n)
+    return best[0], best[1]
+
+
+def tor_rt():
+    """tor_rt stage (measure_all.sh): the real-time-factor report for
+    the TCP model tier. Runs tor (BENCH_TOR_TIER, default the 1020-host
+    tier) and tgen (BENCH_TGEN_PAIRS) each twice in fresh subprocesses
+    — chained drain, then the frontier drain with the runahead widener
+    (BENCH_FRONTIER/BENCH_RUNAHEAD_MS, defaults 16/100) — and prints
+    one JSON dict with sim-s/wall-s + events/sweep for all four runs,
+    each worker's per-phase build/compile/run profile, and the
+    regression delta vs the newest BENCH_r*.json tor record. The two
+    drains are bit-identical by contract (tests/test_model_batching.py)
+    so the pair is a pure price-of-bookkeeping measurement."""
+    tier = os.environ.get("BENCH_TOR_TIER", "2")
+    frontier = os.environ.get("BENCH_FRONTIER", "16")
+    runahead = os.environ.get("BENCH_RUNAHEAD_MS", "100")
+    tmo = int(os.environ.get("BENCH_TOR_RT_TIMEOUT", 2400))
+    out = {"tier": int(tier), "frontier": int(frontier),
+           "runahead_ms": float(runahead)}
+
+    def _run(flag: str, pre: str, tag: str, env: dict) -> dict:
+        for k in ("BENCH_FRONTIER", "BENCH_RUNAHEAD_MS"):
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        r = run_secondary(flag, nominal_timeout=tmo)
+        sub = {k[len(pre):]: v for k, v in r.items() if k.startswith(pre)}
+        if sub:
+            out[tag] = sub
+            print(json.dumps({"tor_rt": out}), flush=True)
+        return sub
+
+    os.environ["BENCH_TOR_TIER"] = tier
+    tor_ch = _run("--tor-worker", "tor_", "tor_chained", {})
+    tor_fr = _run("--tor-worker", "tor_", "tor_frontier",
+                  {"BENCH_FRONTIER": frontier, "BENCH_RUNAHEAD_MS": runahead})
+    tgen_ch = _run("--tgen-worker", "tgen_", "tgen_chained", {})
+    tgen_fr = _run("--tgen-worker", "tgen_", "tgen_frontier",
+                   {"BENCH_FRONTIER": frontier,
+                    "BENCH_RUNAHEAD_MS": runahead})
+
+    prev_label, prev = previous_tor_record()
+    if prev_label and tor_fr:
+        out["prev_bench"] = prev_label
+        pv = float(prev.get("tor_sim_s_per_wall_s", 0.0))
+        pe = float(prev.get("tor_events_per_sweep", 0.0))
+        nv = float(tor_fr.get("sim_s_per_wall_s", 0.0))
+        ne = float(tor_fr.get("events_per_sweep", 0.0))
+        if pv > 0 and nv > 0:
+            out["tor_delta_pct"] = round((nv - pv) / pv * 100.0, 1)
+            print(f"tor_rt: {pv:.3f} -> {nv:.3f} sim-s/wall-s, "
+                  f"{out['tor_delta_pct']:+.1f}% vs {prev_label}",
+                  file=sys.stderr, flush=True)
+        if pe > 0 and ne > 0:
+            out["tor_events_per_sweep_x"] = round(ne / pe, 2)
+            print(f"tor_rt: {pe:.1f} -> {ne:.1f} events/sweep, "
+                  f"x{out['tor_events_per_sweep_x']:.2f} vs {prev_label}",
+                  file=sys.stderr, flush=True)
+    if tor_ch and tor_fr:
+        cv = float(tor_ch.get("sim_s_per_wall_s", 0.0))
+        nv = float(tor_fr.get("sim_s_per_wall_s", 0.0))
+        if cv > 0 and nv > 0:
+            out["tor_frontier_x"] = round(nv / cv, 2)
+    if tgen_ch and tgen_fr:
+        cv = float(tgen_ch.get("sim_s_per_wall_s", 0.0))
+        nv = float(tgen_fr.get("sim_s_per_wall_s", 0.0))
+        if cv > 0 and nv > 0:
+            out["tgen_frontier_x"] = round(nv / cv, 2)
+    print(json.dumps({"tor_rt": out}), flush=True)
 
 
 def previous_bench() -> tuple[str, float]:
@@ -1144,6 +1362,8 @@ def print_delta(out: dict) -> None:
 def main():
     for flag, fn in (("--tor-worker", tor_worker),
                      ("--tor-churn-worker", tor_churn_worker),
+                     ("--tgen-worker", tgen_worker),
+                     ("--tor-rt", tor_rt),
                      ("--btc-worker", btc_worker),
                      ("--phold-worker", phold_worker),
                      ("--phold-big-worker", phold_big_worker),

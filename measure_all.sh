@@ -18,6 +18,12 @@ run tor1      1800 --tor-worker      BENCH_TOR_TIER=1
 run tor2      2400 --tor-worker      BENCH_TOR_TIER=2
 run tor3      3600 --tor-worker      BENCH_TOR_TIER=3
 run tor0nocpu 1500 --tor-worker      BENCH_TOR_TIER=0 BENCH_TOR_CPU=0
+# real-time-factor stage for the TCP model tier: tor (1020-host tier)
+# and tgen, each chained vs frontier drain (+100 ms runahead), with
+# per-phase profiles and the delta vs the newest BENCH_r* tor record
+# (docs/11-Performance.md "Model-tier batching")
+run tor_rt    7200 --tor-rt          BENCH_TOR_TIER=2 BENCH_FRONTIER=16 \
+  BENCH_RUNAHEAD_MS=100 BENCH_TOR_RT_TIMEOUT=1800
 run btc       1800 --btc-worker
 run phold     900  --phold-worker    BENCH_STOP_S=20
 run phold16k  1200 --phold-big-worker BENCH_STOP_S=20
@@ -71,10 +77,11 @@ done
 # must equal the run summary and the in-band [metrics] rows exactly.
 run metrics_smoke 900 --metrics-smoke-worker JAX_PLATFORMS=cpu \
   BENCH_BUDGET_S=840
-# perf smoke: a small CPU-backend PHOLD against the checked-in
-# PERF_FLOOR.json floor — fails (exit 1) when events/s regresses more
-# than 30%. Together with the lint + hlo_audit stage below this is the
-# no-TPU regression lane; refresh the floor deliberately with
+# perf smoke: a small CPU-backend PHOLD plus a small tgen TCP workload
+# under the frontier drain, each against its checked-in PERF_FLOOR.json
+# floor — fails (exit 1) when either events/s regresses more than 30%.
+# Together with the lint + hlo_audit stage below this is the no-TPU
+# regression lane; refresh the floors deliberately with
 # `PERF_SMOKE_UPDATE=1 python bench.py --perf-smoke`.
 echo "=== perf_smoke start $(date +%H:%M:%S)" >> "$S"
 echo "{\"stage\": \"perf_smoke\"}" >> "$R"

@@ -197,6 +197,10 @@ def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
       the donating extraction jits the CLI heartbeat loop calls.
     - sharded_step: Simulation._wrap(engine.run) over an 8-device
       mesh (shard_map path) — skipped when fewer devices exist.
+    - frontier_run: jit(Engine.run, donate_argnums=0) on a
+      frontier-drain TCP build (docs/11-Performance.md "Model-tier
+      batching") — the per-round outbuf staging must not break the
+      state carry's aliasing.
     """
     import jax.numpy as jnp
 
@@ -205,6 +209,18 @@ def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
     def engine_run() -> dict:
         eng, st, stop = _phold_tiny()
         return audit_fn(eng.run, (st, stop), 0, "engine_run")
+
+    def frontier_run() -> dict:
+        from shadow_tpu import examples
+        from shadow_tpu.config import parse_config
+        from shadow_tpu.sim import build_simulation
+
+        text = examples.tgen_example(n_pairs=2, stoptime=5)
+        sim = build_simulation(parse_config(text), seed=3, n_sockets=4,
+                               frontier=4)
+        return audit_fn(sim.engine.run,
+                        (sim.state0, jnp.int64(sim.stop_ns)),
+                        0, "frontier_run")
 
     def pressure_step() -> dict:
         sim = _sim_tiny(overflow="spill", spill_len=64)
@@ -231,6 +247,7 @@ def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
                          "sharded_step")
 
     targets["engine_run"] = engine_run
+    targets["frontier_run"] = frontier_run
     targets["pressure_step"] = pressure_step
     targets["harvest_full"] = lambda: _harvest(True)
     targets["harvest_light"] = lambda: _harvest(False)

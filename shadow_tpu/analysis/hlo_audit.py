@@ -149,6 +149,15 @@ CONTRACTS: dict[str, HloContract] = {
     "tgen": HloContract("tgen", _budget(11)),
     "tor": HloContract("tor", _budget(7)),
     "bitcoin": HloContract("bitcoin", _budget(21)),
+    # The same configs under the frontier drain (ISSUE 13 model-tier
+    # batching). Budgets pinned equal to the chained contracts: the
+    # frontier executor is built on sort / one-hot select / dynamic
+    # slice only, so switching drains must add NO scatter — a frontier
+    # budget above its chained twin means per-position bookkeeping
+    # regressed into scattered writes.
+    "tgen_frontier": HloContract("tgen_frontier", _budget(11)),
+    "tor_frontier": HloContract("tor_frontier", _budget(7)),
+    "bitcoin_frontier": HloContract("bitcoin_frontier", _budget(21)),
     # The SPMD lowering of the raw PHOLD window loop over an 8-device
     # mesh. Every count is structural (per traced site x per Events
     # leaf), none scale with hosts or events:
@@ -219,19 +228,26 @@ def _build(name: str):
     from shadow_tpu.config import parse_config
     from shadow_tpu.sim import build_simulation
 
-    if name == "phold_net":
+    # `<model>_frontier` lowers the identical config under the frontier
+    # drain (docs/11-Performance.md "Model-tier batching") — a separate
+    # contract because the window loop's body is a different program
+    base, frontier = name, 0
+    if name.endswith("_frontier"):
+        base, frontier = name[: -len("_frontier")], 8
+
+    if base == "phold_net":
         text = examples.phold_example(8, msgs_per_host=2, stoptime=5)
-    elif name == "tgen":
+    elif base == "tgen":
         text = examples.example_config()
-    elif name == "tor":
+    elif base == "tor":
         text = examples.tor_example(n_relays_per_class=2, n_clients=4,
                                     n_servers=2, stoptime=5)
-    elif name == "bitcoin":
+    elif base == "bitcoin":
         text = examples.bitcoin_example(n_nodes=8, blocks=1, stoptime=5)
     else:
         raise KeyError(f"unknown model config `{name}` "
                        f"(have {sorted(CONTRACTS)})")
-    sim = build_simulation(parse_config(text), seed=3)
+    sim = build_simulation(parse_config(text), seed=3, frontier=frontier)
     return sim.engine.run, sim.state0, jnp.int64(sim.stop_ns)
 
 

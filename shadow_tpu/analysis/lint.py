@@ -69,6 +69,16 @@ way those disciplines have been (or nearly were) broken:
   docs/12-Sharding.md post-mortem). The engine computes every such
   flag in the loop BODY and threads it through the carry
   (``core.engine._drain_flag``); this rule pins that structurally.
+- SL112 computed-index gather of a global ``[NC]``-sized table inside
+  vmapped handler scope — model handlers receive the global config
+  dict ``g`` and by convention index its per-host tables with their
+  own gid (``g["count"][me]``): under vmap that lowers to a cheap
+  aligned row select. Indexing with any *other* traced value
+  (``g["recvsize"][pkt.src_host]``) lowers to a full gather across the
+  whole table per host per sweep — O(H·NC) traffic that scales
+  quadratically with host count and silently dominates city-scale
+  builds. Cross-host lookups are sometimes the point; sanctioned sites
+  carry ``# shadowlint: disable=SL112`` with a reason.
 
 Findings carry a stable key (rule | relpath | enclosing function |
 stripped source line) so the baseline survives unrelated line drift.
@@ -97,7 +107,17 @@ RULES = {
     "SL109": "blocking device sync outside watchdog-scoped sites",
     "SL110": "wall-clock read inside jit scope",
     "SL111": "donated buffer double-donated or reused after donation",
+    "SL112": "computed-index gather of a global host table in handler scope",
 }
+
+# SL112: names under which model handlers receive the global config
+# dict (models/*.py convention: `def build(...)` packs per-host tables
+# into `g`, handlers close over it or take it as a parameter).
+_GLOBAL_TABLE_NAMES = {"g", "_g", "gtab", "gtables"}
+# Index heads that select the handler's OWN row (aligned under vmap):
+# the gid convention plus static full-range constructions.
+_OWN_GID_NAMES = {"me", "gid", "gids"}
+_STATIC_INDEX_CALLS = {"arange", "iota", "broadcasted_iota"}
 
 # SL110: time-module entry points that read the wall clock. Bare-name
 # calls (``from time import perf_counter``) match everything except
@@ -889,6 +909,55 @@ class _Linter(ast.NodeVisitor):
     visit_SetComp = _visit_comp
     visit_DictComp = _visit_comp
     visit_GeneratorExp = _visit_comp
+
+    # ----------------------------------------------------- SL112 gather
+
+    def _in_handler_scope(self) -> bool:
+        # Model handlers lower under the engine's vmap even though no
+        # jit wrapper appears in the model file itself: they are either
+        # closures inside a *make_handlers factory or `_on_*` methods
+        # registered by one (models/*.py convention). Jit scope proper
+        # also counts.
+        return self._in_jit() or any(
+            "handlers" in s.name or s.name.startswith("_on_")
+            for s in self.scopes[1:])
+
+    @staticmethod
+    def _is_own_row_index(idx: ast.AST) -> bool:
+        # Only the FIRST index element picks the host row; trailing
+        # elements (`g["peers"][me, j]`) index within the own row.
+        if isinstance(idx, ast.Tuple) and idx.elts:
+            idx = idx.elts[0]
+        if isinstance(idx, (ast.Constant, ast.Slice)):
+            return True
+        if isinstance(idx, ast.Name):
+            return idx.id in _OWN_GID_NAMES
+        if isinstance(idx, ast.Attribute):
+            return idx.attr in _OWN_GID_NAMES
+        if isinstance(idx, ast.Call):
+            return _call_basename(idx.func) in _STATIC_INDEX_CALLS
+        return False
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        inner = node.value
+        if (isinstance(inner, ast.Subscript)
+                and isinstance(inner.slice, ast.Constant)
+                and isinstance(inner.slice.value, str)
+                and _attr_root(inner.value) in _GLOBAL_TABLE_NAMES
+                and self._in_handler_scope()
+                and not self._is_own_row_index(node.slice)):
+            table = _unparse(inner)
+            head = node.slice
+            if isinstance(head, ast.Tuple) and head.elts:
+                head = head.elts[0]
+            self._emit(
+                "SL112", node,
+                f"`{table}[{_unparse(head)}]` gathers a global table by "
+                f"a computed index inside vmapped handler scope — under "
+                f"vmap this reads the whole [NC] table per host per "
+                f"sweep; index by own gid (`me`) or, if the cross-host "
+                f"lookup is intended, suppress with a reason")
+        self.generic_visit(node)
 
 
 class _JitMarker(ast.NodeVisitor):

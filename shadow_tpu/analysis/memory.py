@@ -24,9 +24,12 @@ when the carried state or the window loop's temporaries move — the
 checked-in budgets in `MEM_BUDGETS.json` turn that movement into a
 review-visible diff instead of a silent 2x on real silicon.
 
-Budgets cover the five model configs plus `phold_fleet` — the raw
-PHOLD engine vmapped over a 4-scenario fleet axis — so item-3 scaling
-regressions are caught before the fleet harness exists. Refresh with
+Budgets cover the five model configs, the frontier-drain twins of the
+three TCP models (`*_frontier` — the per-round outbuf/trace staging is
+the frontier executor's only extra live state, and these entries keep
+its growth review-visible), plus `phold_fleet` — the raw PHOLD engine
+vmapped over a 4-scenario fleet axis — so item-3 scaling regressions
+are caught before the fleet harness exists. Refresh with
 ``python -m shadow_tpu.tools.lint --mem-audit --update-baseline``.
 """
 
@@ -47,6 +50,7 @@ BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 FLEET = 4
 
 MEM_CONFIGS = ("phold", "phold_net", "tgen", "tor", "bitcoin",
+               "tgen_frontier", "tor_frontier", "bitcoin_frontier",
                "phold_fleet")
 
 

@@ -339,6 +339,26 @@ class EngineConfig:
     # interpret-mode off-TPU). The two are bit-identical by construction
     # and pinned so by tests/test_kernel_equivalence.py.
     kernel: str = "xla"
+    # Frontier run batching: the THIRD drain contract, between the fully
+    # chained path and the commutative batch_handler path. When > 0 (and
+    # no batch_handler is installed) the window drain runs
+    # `_drain_window_frontier`: per round each host's staged events are
+    # key-sorted once and a RUN — the maximal prefix of equal-time,
+    # same-kind events, capped at this many positions — executes through
+    # a sequential position fold whose per-step cost is only the handler
+    # pass + routing; the per-event staging bookkeeping the chained path
+    # pays every step (min-key selection, rank-matched append, trace
+    # append) amortizes to once per round. Results are BIT-IDENTICAL to
+    # the chained drain (tests/test_model_batching.py pins state, emit
+    # order, and trace records); only the sweep's sequential decomposition
+    # changes, so stats.n_inner_steps counts fold positions as before but
+    # reaches the same total along fewer synchronization points.
+    # Soundness needs every LOCAL emit scheduled at dt >= 1 (the
+    # transport/model tier declares this; sim.build_simulation refuses
+    # configs that cannot) so in-round emits can never precede a run
+    # member. 0 (the default) compiles the frontier path away entirely:
+    # the lowered program is byte-identical to a knob-free build.
+    frontier: int = 0
 
     def __post_init__(self):
         if self.kernel not in ("xla", "pallas"):
@@ -375,6 +395,8 @@ class EngineConfig:
                 f"(got {self.eff_stage_width}); shrink drain_batch/"
                 "stage_width or disable burst"
             )
+        if self.frontier < 0:
+            raise ValueError(f"frontier must be >= 0, got {self.frontier}")
         if self.stage_width and self.stage_width < self.eff_drain_batch + self.max_emit:
             # staging must hold a full frontier dump plus one handler's
             # emits, or the chained drain could stall with zero headroom
@@ -428,7 +450,7 @@ class Engine:
 
     def __init__(self, cfg: EngineConfig, handlers: Sequence[Handler], network,
                  cpu_cost=None, batch_handler=None, faults=None,
-                 fault_reset=None):
+                 fault_reset=None, frontier_kinds=None):
         """`cpu_cost`: optional per-event virtual-CPU nanoseconds, indexed
         by GLOBAL host id (the reference's per-host CPU model delays
         event execution while the virtual CPU is busy — cpu.c:56-107,
@@ -468,11 +490,22 @@ class Engine:
         epoch transitions wipe crashed hosts' queues and re-template
         their state rows from `fault_reset` (a global-shaped hosts
         pytree: the same initial SimHost the simulation was built with,
-        so a restarted host comes back with fresh listening sockets)."""
+        so a restarted host comes back with fresh listening sockets).
+
+        `frontier_kinds`: static tuple of event kinds allowed to form
+        multi-position runs under the frontier drain (cfg.frontier > 0).
+        Kinds outside the set still execute — one position per round, in
+        exact chained order (the explicit in-host ordering fold) — they
+        just never amortize. None (the default) allows every kind.
+        Ignored when cfg.frontier == 0."""
         self.cfg = cfg
         self.handlers = tuple(handlers)
         self.network = network
         self.batch_handler = batch_handler
+        self._frontier_kinds = (
+            tuple(sorted({int(x) for x in frontier_kinds}))
+            if frontier_kinds is not None else None
+        )
         self._base_key = srng.root_key(cfg.seed)
         hg = cfg.n_hosts * cfg.n_shards
         nk = len(self.handlers)
@@ -682,6 +715,11 @@ class Engine:
         if self.batch_handler is not None:
             b = max(1, min(self.cfg.drain_batch, self.cfg.capacity))
             return b * (1 + k)
+        if self.cfg.frontier > 0:
+            # the frontier drain defers tracing to one append per round:
+            # up to `u` positions of (1 exec + K emit) records each
+            u = max(1, min(self.cfg.frontier, self.cfg.eff_stage_width))
+            return u * (1 + k)
         return 1 + k
 
     def init_state(self, hosts: Any, initial: Events, host0: int | jax.Array = 0):
@@ -1319,6 +1357,8 @@ class Engine:
     def _drain_window(self, st: EngineState, window_end, host0):
         if self.batch_handler is not None:
             return self._drain_window_batched(st, window_end, host0)
+        if self.cfg.frontier > 0:
+            return self._drain_window_frontier(st, window_end, host0)
         cfg = self.cfg
         h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
         b = cfg.eff_drain_batch
@@ -1572,6 +1612,455 @@ class Engine:
             q, xchg = self._xchg_deliver(q, xchg, host0)
         # each shard's inner loop trips independently; fold this window's
         # delta across shards so the counter stays replicated-consistent
+        inner = st.stats.n_inner_steps + self._gsum(
+            stats.n_inner_steps - st.stats.n_inner_steps
+        )
+        return dataclasses.replace(
+            st,
+            queues=q,
+            hosts=hosts,
+            src_seq=src_seq,
+            exec_cnt=exec_cnt,
+            stats=dataclasses.replace(
+                stats, n_windows=stats.n_windows + 1, n_inner_steps=inner
+            ),
+            cpu_free=cpu_free,
+            trace=trace,
+            xchg=xchg,
+        )
+
+    # -- frontier drain: kind-partitioned runs, per-round bookkeeping --------
+    def _drain_window_frontier(self, st: EngineState, window_end, host0):
+        """The third drain contract (cfg.frontier > 0): bit-identical to
+        the chained drain, amortized bookkeeping.
+
+        Per round, each host's staging is key-sorted ONCE so the
+        executable events form a column prefix; a sequential position
+        fold (a while_loop capped at `u` positions with global early
+        exit) then executes, per host, the maximal prefix RUN of
+        equal-time same-kind events — per position it pays only the
+        vmapped handler pass + routing. The per-event staging work the
+        chained path repeats every step — the [H, S] min-key selection,
+        the [H, S, K] rank-matched append, the trace-ring append —
+        happens once per ROUND: executed slots clear as a prefix compare
+        on the sorted buffer, every position's routed emits land in one
+        deferred `_stage_append`, and tracing is one wide append whose
+        per-host record order (position-major, exec then emits) matches
+        the chained per-step appends record for record.
+
+        Why the run rule is exact: run members share one time t, and
+        every in-round LOCAL emit is scheduled at >= t+1 (the dt >= 1
+        invariant the transport/model tier declares; remote emits are
+        barrier-clamped >= window_end), so no emit can precede a
+        remaining run member in (time, src, seq) order — the sorted
+        column j IS the host's minimum staged event when position j
+        executes, exactly what the chained drain would have selected.
+        Per-host stall conditions (CPU busy past the barrier, queue-head
+        guard, append headroom) are evaluated per position with the same
+        accounting the chained path uses, and they are monotone within a
+        sweep, so both paths stop each host at the same event. The
+        same-kind rule partitions each round by handler kind ("every
+        kind runs once per round"); kinds outside `frontier_kinds`
+        execute one position per round — the explicit in-host ordering
+        fold for kinds that want visible sequential granularity.
+        """
+        cfg = self.cfg
+        h, k, c = cfg.n_hosts, cfg.max_emit, cfg.capacity
+        b = cfg.eff_drain_batch
+        sw = max(cfg.eff_stage_width, b + k)
+        u = max(1, min(cfg.frontier, sw))
+        gids = host0 + jnp.arange(h, dtype=jnp.int32)
+        cpu_cost = self.cpu_cost[gids]  # [H, NK] this shard's costs
+        al_sh = self._alive_slice(host0) if self._f_crash else None
+        fk = self._frontier_kinds
+        use_tr = self._trace and st.trace is not None
+        if use_tr:
+            from shadow_tpu.obs.trace import (
+                OP_DROP, OP_EXEC, OP_FDROP, OP_SEND, trace_append,
+            )
+        la = cfg.trace_len_arg
+        i64max = jnp.iinfo(jnp.int64).max
+
+        def per_host(hs, e, key):
+            branches = tuple(
+                (lambda fn: lambda: _pad(fn(hs, e, key), k))(fn)
+                for fn in self.handlers
+            )
+
+            def _pad(res, kk):
+                hs2, em = res
+                return hs2, em.pad_to(kk)
+
+            idx = jnp.clip(e.kind, 0, len(branches) - 1)
+            return jax.lax.switch(idx, branches)
+
+        def outer_cond(carry):
+            # carried flag (see the chained drain): the psum/any runs in
+            # the body, never in this predicate
+            return carry[0]
+
+        def outer_body(carry):
+            _, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            q, xchg = self._xchg_deliver(q, xchg, host0)
+
+            # 1. frontier dump into staging — identical to the chained
+            # drain (same prefix clear, same optional burst fold)
+            bvalid = q.time[:, :b] < window_end
+            ndump = jnp.sum(bvalid, axis=1, dtype=jnp.int32)
+            pad = ((0, 0), (0, sw - b))
+            stage = Events(
+                time=jnp.pad(
+                    jnp.where(bvalid, q.time[:, :b], TIME_INVALID),
+                    pad, constant_values=TIME_INVALID,
+                ),
+                dst=jnp.pad(jnp.broadcast_to(gids[:, None], (h, b)), pad),
+                src=jnp.pad(q.src[:, :b], pad),
+                seq=jnp.pad(q.seq[:, :b], pad),
+                kind=jnp.pad(q.kind[:, :b], pad),
+                args=jnp.pad(q.args[:, :b], (*pad, (0, 0))),
+            )
+            cleared = jnp.arange(c, dtype=jnp.int32)[None, :] < ndump[:, None]
+            q = dataclasses.replace(
+                q, time=jnp.where(cleared, TIME_INVALID, q.time)
+            )
+            if cfg.burst is not None:
+                stage = self._burst_fold(stage)
+
+            # queue-head guard — identical to the chained drain
+            headsel = (
+                jnp.arange(c, dtype=jnp.int32)[None, :] == ndump[:, None]
+            )
+            qh_t = jnp.min(jnp.where(headsel, q.time, i64max), axis=1)
+            qh_ss = jnp.min(
+                jnp.where(
+                    headsel & (q.time != TIME_INVALID),
+                    pack_srcseq(q.src, q.seq), i64max,
+                ),
+                axis=1,
+            )
+
+            def precede_q(ev_t, ev_ss):
+                return (ev_t < qh_t) | ((ev_t == qh_t) & (ev_ss < qh_ss))
+
+            def can_run(sm, cpu_free):
+                ev, mss, _oh, cnt = sm
+                mt = ev.time
+                eff = jnp.maximum(mt, cpu_free) if self._cpu_enabled else mt
+                return jnp.any(
+                    (eff < window_end) & precede_q(mt, mss) & (cnt + k <= sw)
+                )
+
+            # 2. rounds: sort once, execute a run, bookkeep once
+            def round_cond(rc):
+                return rc[0]
+
+            def round_body(rc):
+                _, stage, hosts, src_seq, exec_cnt, stats, cpu_free, trace = rc
+                skey = pack_srcseq(stage.src, stage.seq)
+                t2, ss2, dst2, src2, seq2, kind2, *acols = jax.lax.sort(
+                    (stage.time, skey, stage.dst, stage.src, stage.seq,
+                     stage.kind,
+                     *[stage.args[:, :, i] for i in range(cfg.n_args)]),
+                    dimension=1, num_keys=2,
+                )
+                args2 = jnp.stack(acols, axis=-1)
+                cnt0 = jnp.sum(
+                    t2 != TIME_INVALID, axis=1, dtype=jnp.int32
+                )
+                t0 = t2[:, 0]
+                kind0 = kind2[:, 0]
+                if fk is not None:
+                    allowed0 = jnp.zeros((h,), bool)
+                    for kk in fk:
+                        allowed0 = allowed0 | (kind0 == kk)
+                uidx = jnp.arange(u, dtype=jnp.int32)
+
+                def pos_cond(pc):
+                    return pc[0]
+
+                def pos_body(pc):
+                    (_, j, still, hosts, src_seq, exec_cnt, stats,
+                     cpu_free, cnt, nact, outbuf, trbuf) = pc
+                    col = lambda a: jax.lax.dynamic_index_in_dim(
+                        a, j, axis=1, keepdims=False
+                    )
+                    ev_t = col(t2)
+                    ev_ss = col(ss2)
+                    e_src = col(src2)
+                    e_seq = col(seq2)
+                    e_kind = col(kind2)
+                    e_args = col(args2)
+                    eff_t = (
+                        jnp.maximum(ev_t, cpu_free)
+                        if self._cpu_enabled else ev_t
+                    )
+                    member = (ev_t == t0) & (e_kind == kind0)
+                    if fk is not None:
+                        member = member & (allowed0 | (j == 0))
+                    active = (
+                        still & member
+                        & (ev_t != TIME_INVALID)
+                        & (eff_t < window_end)
+                        & precede_q(ev_t, ev_ss)
+                        & (cnt + k <= sw)
+                    )
+                    if self._f_crash:
+                        alv = self._alive_at(al_sh, eff_t)
+                        runm = active & alv
+                        stats = dataclasses.replace(
+                            stats,
+                            n_quarantined=stats.n_quarantined
+                            + (active & ~alv),
+                        )
+                    else:
+                        runm = active
+                    ev = Events(
+                        time=jnp.where(runm, eff_t, TIME_INVALID),
+                        dst=gids, src=e_src, seq=e_seq, kind=e_kind,
+                        args=e_args,
+                    )
+                    hkeys, rkeys = srng.event_keys(
+                        self._base_key, gids, exec_cnt
+                    )
+                    hosts2, emit = jax.vmap(per_host)(hosts, ev, hkeys)
+                    hosts = _select_rows(runm, hosts2, hosts)
+                    emask = emit.mask & runm[:, None]
+                    inc = emask.astype(jnp.int32)
+                    within = jnp.cumsum(inc, axis=1) - inc
+                    seq = src_seq[:, None] + within
+                    src_seq = src_seq + jnp.sum(inc, axis=1, dtype=jnp.int32)
+                    out, final_mask, dropped, fdropped, _t, _is_local = (
+                        self._route(
+                            emit, ev.time, gids, window_end, rkeys, emask,
+                            seq,
+                        )
+                    )
+                    if self._cpu_enabled:
+                        ev_cost = _kind_cost(cpu_cost, ev.kind)
+                        if cfg.burst is not None:
+                            bkind, _sq, blen = cfg.burst[:3]
+                            lw = ev.args[:, blen]
+                            nseg = jnp.where(
+                                (lw & BURST_LEN_MASK) > 0,
+                                jnp.maximum(lw >> BURST_NSEG_SHIFT, 1), 1,
+                            )
+                            ev_cost = ev_cost * jnp.where(
+                                ev.kind == bkind,
+                                nseg.astype(ev_cost.dtype), 1,
+                            )
+                        cpu_free = jnp.where(
+                            runm & (ev_cost > 0), eff_t + ev_cost, cpu_free
+                        )
+                    exec_cnt = exec_cnt + runm.astype(jnp.int32)
+                    stats = dataclasses.replace(
+                        stats,
+                        n_executed=stats.n_executed + runm,
+                        n_emitted=stats.n_emitted
+                        + jnp.sum(inc, axis=1, dtype=jnp.int64),
+                        n_net_dropped=stats.n_net_dropped
+                        + jnp.sum(dropped, axis=1, dtype=jnp.int64),
+                        n_fault_dropped=stats.n_fault_dropped
+                        + jnp.sum(fdropped, axis=1, dtype=jnp.int64),
+                        n_by_kind=stats.n_by_kind + (
+                            jax.nn.one_hot(
+                                jnp.clip(
+                                    ev.kind, 0, len(self.handlers) - 1
+                                ),
+                                len(self.handlers), dtype=jnp.int64,
+                            )
+                            * runm[:, None]
+                        ),
+                    )
+                    cnt = (
+                        cnt - active.astype(jnp.int32)
+                        + jnp.sum(final_mask, axis=1, dtype=jnp.int32)
+                    )
+                    nact = nact + active.astype(jnp.int32)
+
+                    def buf_put(buf, v):
+                        m = (uidx == j).reshape(
+                            (1, u) + (1,) * (buf.ndim - 2)
+                        )
+                        return jnp.where(m, v[:, None], buf)
+
+                    outbuf = Events(
+                        time=buf_put(outbuf.time, out.time),
+                        dst=buf_put(outbuf.dst, out.dst),
+                        src=buf_put(outbuf.src, out.src),
+                        seq=buf_put(outbuf.seq, out.seq),
+                        kind=buf_put(outbuf.kind, out.kind),
+                        args=buf_put(outbuf.args, out.args),
+                    )
+                    if use_tr:
+                        ecol = lambda a: a[:, None]
+                        op_send = jnp.where(
+                            dropped, OP_DROP,
+                            jnp.where(fdropped, OP_FDROP, OP_SEND),
+                        ).astype(jnp.int32)
+                        row = (
+                            jnp.concatenate(
+                                [ecol(ev.time),
+                                 jnp.broadcast_to(ecol(ev.time), (h, k))],
+                                1,
+                            ),
+                            jnp.concatenate([ecol(ev.src), out.src], 1),
+                            jnp.concatenate([ecol(ev.dst), out.dst], 1),
+                            jnp.concatenate([ecol(ev.kind), out.kind], 1),
+                            jnp.concatenate(
+                                [ev.args[:, la:la + 1],
+                                 out.args[:, :, la]], 1
+                            ),
+                            jnp.concatenate([ecol(ev.seq), out.seq], 1),
+                            jnp.concatenate(
+                                [jnp.full((h, 1), OP_EXEC, jnp.int32),
+                                 op_send], 1
+                            ),
+                            jnp.concatenate(
+                                [ecol(runm), emask & ~_is_local], 1
+                            ),
+                        )
+                        trbuf = tuple(
+                            buf_put(bb, vv) for bb, vv in zip(trbuf, row)
+                        )
+                    go = jnp.any(active) & (j + 1 < u)
+                    return (go, j + 1, active, hosts, src_seq, exec_cnt,
+                            stats, cpu_free, cnt, nact, outbuf, trbuf)
+
+                outbuf0 = Events(
+                    time=jnp.full((h, u, k), TIME_INVALID, jnp.int64),
+                    dst=jnp.zeros((h, u, k), jnp.int32),
+                    src=jnp.zeros((h, u, k), jnp.int32),
+                    seq=jnp.zeros((h, u, k), jnp.int32),
+                    kind=jnp.zeros((h, u, k), jnp.int32),
+                    args=jnp.zeros((h, u, k, cfg.n_args), jnp.int32),
+                )
+                trbuf0 = None
+                if use_tr:
+                    z32 = jnp.zeros((h, u, 1 + k), jnp.int32)
+                    trbuf0 = (
+                        jnp.zeros((h, u, 1 + k), jnp.int64),
+                        z32, z32, z32, z32, z32, z32,
+                        jnp.zeros((h, u, 1 + k), bool),
+                    )
+                (_, jn, _still, hosts, src_seq, exec_cnt, stats, cpu_free,
+                 _cnt, nact, outbuf, trbuf) = jax.lax.while_loop(
+                    pos_cond, pos_body,
+                    (jnp.asarray(True), jnp.zeros((), jnp.int32),
+                     jnp.ones((h,), bool), hosts, src_seq, exec_cnt,
+                     stats, cpu_free, cnt0, jnp.zeros((h,), jnp.int32),
+                     outbuf0, trbuf0),
+                )
+
+                # 3. per-round bookkeeping: prefix-clear the executed
+                # columns, one deferred append of every position's routed
+                # emits (headroom is guaranteed — the per-position gate
+                # kept cnt + K <= SW with the exact chained accounting),
+                # one wide trace append in chained record order
+                colmask = (
+                    jnp.arange(sw, dtype=jnp.int32)[None, :] < nact[:, None]
+                )
+                stage = Events(
+                    time=jnp.where(colmask, TIME_INVALID, t2),
+                    dst=dst2, src=src2, seq=seq2, kind=kind2, args=args2,
+                )
+                stage = self._stage_append(
+                    stage,
+                    Events(
+                        time=outbuf.time.reshape(h, u * k),
+                        dst=outbuf.dst.reshape(h, u * k),
+                        src=outbuf.src.reshape(h, u * k),
+                        seq=outbuf.seq.reshape(h, u * k),
+                        kind=outbuf.kind.reshape(h, u * k),
+                        args=outbuf.args.reshape(h, u * k, cfg.n_args),
+                    ),
+                )
+                if use_tr:
+                    w = u * (1 + k)
+                    rs = lambda a: a.reshape(h, w)
+                    trace = trace_append(
+                        trace, cfg.trace,
+                        time=rs(trbuf[0]), src=rs(trbuf[1]),
+                        dst=rs(trbuf[2]), kind=rs(trbuf[3]),
+                        plen=rs(trbuf[4]), seq=rs(trbuf[5]),
+                        op=rs(trbuf[6]), mask=rs(trbuf[7]),
+                    )
+                stats = dataclasses.replace(
+                    stats,
+                    n_inner_steps=stats.n_inner_steps
+                    + jn.astype(jnp.int64),
+                )
+                sm2 = self._stage_min(stage)
+                return (can_run(sm2, cpu_free), stage, hosts, src_seq,
+                        exec_cnt, stats, cpu_free, trace)
+
+            sm0 = self._stage_min(stage)
+            (_, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
+             trace) = jax.lax.while_loop(
+                round_cond, round_body,
+                (can_run(sm0, cpu_free), stage, hosts, src_seq, exec_cnt,
+                 stats, cpu_free, trace),
+            )
+
+            # 4. flush staging leftovers — identical to the chained drain
+            skey = pack_srcseq(stage.src, stage.seq)
+            t2, _ss2, dst2, src2, seq2, kind2, *acols = jax.lax.sort(
+                (stage.time, skey, stage.dst, stage.src, stage.seq,
+                 stage.kind,
+                 *[stage.args[:, :, i] for i in range(cfg.n_args)]),
+                dimension=1, num_keys=2,
+            )
+            stage = Events(
+                time=t2, dst=dst2, src=src2, seq=seq2, kind=kind2,
+                args=jnp.stack(acols, axis=-1),
+            )
+            w1 = min(sw, 16)
+            maxcnt = jnp.max(
+                jnp.sum(stage.time != TIME_INVALID, axis=1, dtype=jnp.int32)
+            )
+
+            def push_narrow(args):
+                q, xchg, stage = args
+                sl = jax.tree.map(lambda a: a[:, :w1], stage)
+                flat = sl.flatten()
+                return self._exchange_push(
+                    q, xchg, flat, flat.time != TIME_INVALID, host0
+                )
+
+            def push_full(args):
+                q, xchg, stage = args
+                flat = stage.flatten()
+                return self._exchange_push(
+                    q, xchg, flat, flat.time != TIME_INVALID, host0
+                )
+
+            if w1 == sw:
+                q, xchg, xr, nc = push_full((q, xchg, stage))
+            elif cfg.axis_name is not None:
+                go_wide = self._gany(maxcnt > w1)
+                q, xchg, xr, nc = jax.lax.cond(
+                    go_wide, push_full, push_narrow, (q, xchg, stage)
+                )
+            else:
+                q, xchg, xr, nc = jax.lax.cond(
+                    maxcnt > w1, push_full, push_narrow, (q, xchg, stage)
+                )
+            stats = dataclasses.replace(
+                stats,
+                n_sweeps=stats.n_sweeps + 1,
+                n_xchg_rounds=stats.n_xchg_rounds + xr,
+                n_cross_shard=stats.n_cross_shard + nc,
+            )
+            more = self._drain_flag(q, cpu_free, window_end)
+            return (more, q, xchg, hosts, src_seq, exec_cnt, stats,
+                    cpu_free, trace)
+
+        carry = (self._drain_flag(st.queues, st.cpu_free, window_end),
+                 st.queues, st.xchg, st.hosts, st.src_seq, st.exec_cnt,
+                 st.stats, st.cpu_free, st.trace)
+        (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free,
+         trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
+        if self._cpu_enabled:
+            q, xchg = self._xchg_deliver(q, xchg, host0)
         inner = st.stats.n_inner_steps + self._gsum(
             stats.n_inner_steps - st.stats.n_inner_steps
         )

@@ -42,6 +42,49 @@ def example_config() -> str:
 </shadow>"""
 
 
+def tgen_example(
+    n_pairs: int = 64,
+    sendsize: str = "16KiB",
+    recvsize: str = "64KiB",
+    count: int = 4,
+    stoptime: int = 60,
+) -> str:
+    """A scalable TGen transfer workload (BASELINE.md configs 1-2 shape
+    scaled out): n_pairs client/server pairs, each client runs `count`
+    request/response streams against its own server with a 1-3 s
+    cycling pause. Client starts stagger over a 5 s period like
+    tor_example so a 10-sim-s window measures steady state.
+
+    The pause choices are all >= 1 s, so the parsed model declares
+    frontier_safe and the config can run under the engine's frontier
+    drain (docs/11-Performance.md "Model-tier batching")."""
+    hosts = []
+    for i in range(n_pairs):
+        hosts.append(
+            f'<host id="srv{i}" bandwidthup="102400" '
+            'bandwidthdown="102400">'
+            '<process plugin="tgen" starttime="1" '
+            'arguments="server port=8888"/>'
+            "</host>"
+        )
+    for i in range(n_pairs):
+        hosts.append(
+            f'<host id="cli{i}" bandwidthup="102400" '
+            'bandwidthdown="102400">'
+            f'<process plugin="tgen" starttime="{3 + (i % 5)}" '
+            f'arguments="peers=srv{i}:8888 sendsize={sendsize} '
+            f'recvsize={recvsize} count={count} pause=1,2,3"/>'
+            "</host>"
+        )
+    return (
+        f'<shadow stoptime="{stoptime}">'
+        f"<topology><![CDATA[{EXAMPLE_TOPOLOGY}]]></topology>"
+        '<plugin id="tgen" path="tgen"/>'
+        + "".join(hosts)
+        + "</shadow>"
+    )
+
+
 def tor_example(
     n_relays_per_class: int = 10,
     n_clients: int = 950,

@@ -176,7 +176,24 @@ class BitcoinModel:
             dl_rx=jnp.zeros((n, s), _I64),
             t_best=jnp.zeros((n,), _I64),
         )
+        # frontier-drain eligibility (sim.build_simulation): the dial
+        # chain re-arms at a 10 ms constant and the miner tick at
+        # interval_ns; both must be >= 1 ns for the run-rule invariant
+        self._frontier_safe = int(interval_s * SECOND) >= 1
         return state, self._make_handlers, self._on_recv
+
+    @property
+    def frontier_safe(self) -> bool:
+        """True when every local emit delay this build can schedule is
+        provably >= 1 ns — the engine frontier drain's run-rule
+        invariant (docs/11-Performance.md, "Model-tier batching")."""
+        return getattr(self, "_frontier_safe", False)
+
+    def frontier_kinds(self) -> tuple:
+        """Model kinds eligible for multi-position frontier runs (all of
+        them: dial/mine re-arms are interval-delayed, announces are
+        TCP-floored)."""
+        return tuple(range(self.n_kinds))
 
     def _make_handlers(self, stack, kind_base):
         self._stack = stack

@@ -248,6 +248,11 @@ class TGenModel:
             n_pause=jnp.asarray(n_pause),
         )
         self._cs = cs
+        # frontier-drain eligibility (sim.build_simulation): inter-stream
+        # pauses are this model's only local emit delays; unused table
+        # rows keep the SECOND default, so the check covers exactly the
+        # configured clients
+        self._frontier_safe = bool((pause_ns >= 1).all())
 
         z32 = jnp.zeros((n,), _I32)
         state = TGenState(
@@ -258,6 +263,18 @@ class TGenModel:
             t_last_done=jnp.zeros((n,), _I64),
         )
         return state, self._make_handlers, self._on_recv
+
+    @property
+    def frontier_safe(self) -> bool:
+        """True when every local emit delay this build can schedule is
+        provably >= 1 ns — the engine frontier drain's run-rule
+        invariant (docs/11-Performance.md, "Model-tier batching")."""
+        return getattr(self, "_frontier_safe", False)
+
+    def frontier_kinds(self) -> tuple:
+        """Model kinds eligible for multi-position frontier runs (all of
+        them: KIND_STREAM's emits are pause-delayed or TCP-floored)."""
+        return tuple(range(self.n_kinds))
 
     # ---------------------------------------------------------- handlers
     def _make_handlers(self, stack, kind_base):
@@ -340,7 +357,9 @@ class TGenModel:
         # ---- server: reply to stream EOF (size from the client's static
         # config), then close
         do_reply = eof & ~is_client_sock
-        reply_sz = g["recvsize"][pkt.src_host]
+        # cross-host lookup is the point: the server replies with the
+        # CLIENT's configured recvsize, known only from its static row
+        reply_sz = g["recvsize"][pkt.src_host]  # shadowlint: disable=SL112
         hs, em_s = tcp.send(hs, slot, reply_sz, now,
                             mask=do_reply & (reply_sz > 0))
         hs, em_c = tcp.close(hs, slot, now, mask=do_reply)

@@ -274,6 +274,12 @@ class TorModel:
             cl_npause[gid_c] = n_pause[ci]
 
         self._role = role  # for the per-kind CPU table
+        # frontier-drain eligibility (sim.build_simulation): the client
+        # think-time pause is this model's only local emit delay — TCP-
+        # side delays are floored at 1 ns by the stack — so the run-rule
+        # invariant holds iff every configured pause is >= 1 ns. Unused
+        # rows keep the SECOND default, so the table-wide check is exact.
+        self._frontier_safe = bool((cl_pause >= 1).all())
 
         s = b.n_sockets
         state = TorApp(
@@ -303,6 +309,18 @@ class TorModel:
         self._stack = stack
         self._kind_fetch = kind_base
         return [self._on_fetch]
+
+    @property
+    def frontier_safe(self) -> bool:
+        """True when every local emit delay this build can schedule is
+        provably >= 1 ns — the engine frontier drain's run-rule
+        invariant (docs/11-Performance.md, "Model-tier batching")."""
+        return getattr(self, "_frontier_safe", False)
+
+    def frontier_kinds(self) -> tuple:
+        """Model kinds eligible for multi-position frontier runs (all of
+        them: KIND_FETCH's emits are pause-delayed or TCP-floored)."""
+        return tuple(range(self.n_kinds))
 
     def cpu_kind_cycles(self, n_kinds: int) -> np.ndarray:
         """Per-(host, kind) cycle charges: relays pay onion-crypto work

@@ -732,6 +732,7 @@ def build_simulation(
     tcp_child_slot_limit: int | None = None,
     locality: bool = False,
     runahead_ns: int | None = None,
+    frontier: int = 0,
     fuse_rx: bool = True,
     burst_rx: bool = True,
     shape_bucket: bool = True,
@@ -765,6 +766,15 @@ def build_simulation(
     checkpoint's leaves regardless of the new mesh's shard count. It
     overrides `locality` (the stored order already IS the writer's
     locality layout) and is legal on any mesh, including unsharded.
+
+    `frontier` (docs/11-Performance.md, "Model-tier batching") selects
+    the engine's third drain contract: per round each host's staged
+    events sort once and a RUN of up to `frontier` equal-time same-kind
+    events executes through a position fold that amortizes the chained
+    drain's per-event bookkeeping. Results are bit-identical to
+    `frontier=0` (the chained default). Requires a TCP stack with
+    fuse_rx=True and a model that declares `frontier_safe` (every local
+    emit scheduled at dt >= 1) — refused loudly otherwise.
     """
     from shadow_tpu.runtime.pressure import OVERFLOW_MODES
 
@@ -1162,12 +1172,34 @@ def build_simulation(
     spill = 0
     if overflow in ("spill", "grow"):
         spill = int(spill_len) if spill_len > 0 else 4 * capacity
+    # frontier drain eligibility: the run rule is only exact when every
+    # LOCAL emit lands at dt >= 1 (engine._drain_window_frontier). The
+    # unfused ARRIVE->RX re-emit violates it (dt can be 0 in bootstrap),
+    # and a model with zero-valued pause/interval tables would too — so
+    # the knob demands fuse_rx + an explicit model-side declaration.
+    frontier_kinds = None
+    if frontier:
+        if tcp is None or not fuse_rx:
+            raise ValueError(
+                "frontier batching requires the TCP stack with "
+                "fuse_rx=True (the unfused ARRIVE->RX re-emit can land "
+                "at dt=0, breaking the run rule's dt >= 1 invariant)"
+            )
+        if not getattr(model, "frontier_safe", False):
+            raise ValueError(
+                f"model {model.name!r} does not declare frontier_safe "
+                "(its local emit delays are not provably >= 1 ns for "
+                "this config); run with frontier=0"
+            )
+        frontier_kinds = stack.frontier_kinds() + tuple(
+            kind_base + int(i) for i in model.frontier_kinds()
+        )
     ecfg = EngineConfig(
         n_hosts=per_shard, capacity=capacity, lookahead=lookahead,
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
         axis_name=axis_name, n_shards=n_shards, burst=burst,
         trace=int(trace), trace_len_arg=int(_A_LEN),
-        spill=spill,
+        spill=spill, frontier=int(frontier),
     )
     network = topo.build_network(host_vertex)
     # per-KIND CPU charges: a model may declare cycle costs for specific
@@ -1231,6 +1263,7 @@ def build_simulation(
         # crashed-and-restarted host comes back with boot-fresh state
         # (listen sockets rebound, app state re-zeroed)
         fault_reset=hosts_state if faults is not None else None,
+        frontier_kinds=frontier_kinds,
     )
 
     # -- initial events: process starts (slave.c:296-336 scheduling of

@@ -444,3 +444,22 @@ class Stack:
         if self.tcp is not None:
             handlers += self.tcp.make_handlers(self)
         return handlers
+
+    def frontier_kinds(self) -> tuple:
+        """Stack-level kinds eligible for multi-position runs under the
+        engine's frontier drain (engine._drain_window_frontier).
+
+        The run rule is only exact when every LOCAL emit a kind can
+        produce lands at dt >= 1. Fused arrivals qualify: the delivery
+        runs inline and every follow-up (tcp tx kick, retransmit timer,
+        delayed ack, app reply) is scheduled through helpers that floor
+        the delay at 1 ns (tcp._kick_row / _arm_row / da_row, the fused
+        re-emit's `finish - now` NIC serialization). KIND_PKT_RX is
+        deliberately absent — when fused it is a stub that never runs,
+        and unfused mode is refused by sim.build_simulation because the
+        bootstrap-phase ARRIVE->RX re-emit can land at dt=0.
+        """
+        fk = (KIND_PKT_ARRIVE,)
+        if self.tcp is not None:
+            fk += (N_STACK_KINDS, N_STACK_KINDS + 1)  # tcp_timer, tcp_tx
+        return fk

@@ -489,6 +489,66 @@ def test_sl111_undonated_calls_untracked():
     assert fs == []
 
 
+def test_sl112_computed_gather_in_handler_scope():
+    # indexing a global table by another host's id gathers the whole
+    # [NC] column per host under vmap — both the `_on_*` method
+    # convention and make_handlers closures are handler scope
+    fs = _lint("""
+        class Model:
+            def _on_recv(self, hs, slot, pkt, now, key):
+                g = self._g
+                reply_sz = g["recvsize"][pkt.src_host]
+                return reply_sz
+
+            def _make_handlers(self, stack, kind_base):
+                g = self._g
+                def h_dial(hs, ev, key):
+                    return g["dials"][ev.src]
+                return (h_dial,)
+    """)
+    assert _rules(fs) == ["SL112"] and len(fs) == 2
+
+
+def test_sl112_own_gid_rows_clean():
+    # the own-row convention — first index element is the handler's
+    # gid (or a static construction) — is an aligned select, not a
+    # gather; trailing in-row indices may be computed
+    fs = _lint("""
+        import jax.numpy as jnp
+        class Model:
+            def _on_recv(self, hs, slot, pkt, now, key):
+                g, me = self._g, hs.gid
+                a = g["count"][me]
+                b = g["peers"][me, slot % 4]
+                c = g["n_blocks"]
+                d = g["pause_ns"][0]
+                e = g["sendsize"][jnp.arange(4)]
+                return a + b + c + d + e
+    """)
+    assert fs == []
+
+
+def test_sl112_silent_outside_handler_scope():
+    # build-time host code reshuffles global tables freely
+    fs = _lint("""
+        def build(self, b):
+            g = self._g
+            order = g["recvsize"][g["peer_gid"]]
+            return order
+    """)
+    assert fs == []
+
+
+def test_sl112_inline_suppression():
+    fs = _lint("""
+        class Model:
+            def _on_recv(self, hs, slot, pkt, now, key):
+                g = self._g
+                return g["recvsize"][pkt.src_host]  # shadowlint: disable=SL112
+    """)
+    assert fs == []
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng
