@@ -374,6 +374,83 @@ def tor_worker():
     }))
 
 
+def tor_analytics_worker():
+    """Instrumented (NOT timed) tor run for the tor_rt analytics row:
+    frontier drain with --stats histograms and the event trace on, so
+    the stage can report p50/p95 frontier run length (the direct
+    measurement of the PR 13 TPU bet) and the critical-path depth/width
+    profile (the sequential ceiling no amount of vmap width can beat).
+    Kept separate from the timed legs: stats/trace change the compiled
+    program, and the timed headline must stay a clean price-of-
+    bookkeeping measurement."""
+    _enable_compile_cache()
+    import jax
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.timebase import SECOND
+    from shadow_tpu.examples import tor_example
+    from shadow_tpu.obs.stats import stats_device_refs, summarize
+    from shadow_tpu.obs.trace import TraceDrain
+    from shadow_tpu.tools.critical_path import analyze
+
+    tier_idx = int(os.environ.get("BENCH_TOR_TIER", 0)) % len(TOR_TIERS)
+    relays, clients, servers = TOR_TIERS[tier_idx]
+    # a short horizon suffices: run-length and dependency-shape
+    # statistics stabilize within a few steady-state seconds
+    stop_s = int(os.environ.get("BENCH_ANALYTICS_STOP_S", 6))
+    frontier = int(os.environ.get("BENCH_FRONTIER", 16))
+    trace_n = int(os.environ.get("BENCH_TRACE", 4096))
+    _stamp(f"tor analytics tier {relays}/{clients}/{servers} "
+           f"frontier={frontier} trace={trace_n}: building")
+    cfg = parse_config(tor_example(
+        n_relays_per_class=relays, n_clients=clients,
+        n_servers=servers, filesize="64KiB", count=2, stoptime=stop_s,
+        relay_cpu_ghz=3.0,
+    ))
+    sim = _build_on_cpu(
+        cfg, seed=1,
+        n_sockets=int(os.environ.get("BENCH_TOR_NSOCK", 32)),
+        capacity=768, frontier=frontier, stats=1, trace=trace_n,
+    )
+    sim.strict_overflow = False
+    td = TraceDrain(trace_n, names=sim.names,
+                    kind_names=list(sim.kind_names))
+    _stamp("build done; instrumented chunked run")
+    # drain the trace ring once per sim-second so it cannot overrun
+    stop_ns = stop_s * SECOND
+    st = sim.run(SECOND)
+    st = td.drain_state(st)
+    k = 2 * SECOND
+    while k <= stop_ns:
+        st = sim.run(k, state=st)
+        st = td.drain_state(st)
+        k += SECOND
+    jax.block_until_ready(st.now)
+    stats = summarize(jax.device_get(stats_device_refs(st.splane)))
+    meta = {"names": sim.names, "kind_names": list(sim.kind_names)}
+    report = analyze(td.records(), meta)
+    _stamp(f"analytics done: {report['execs']} execs, "
+           f"depth {report['depth']}")
+    rl = stats["runlen"]
+    print(json.dumps({
+        "tora_hosts": len(sim.names),
+        "tora_stop_s": stop_s,
+        "tora_frontier": frontier,
+        "tora_runlen_count": rl["count"],
+        "tora_runlen_p50": rl["p50"],
+        "tora_runlen_p95": rl["p95"],
+        "tora_runlen_mean": round(rl["mean"], 2),
+        "tora_wait_p50_ns": stats["wait"]["p50"],
+        "tora_wait_p95_ns": stats["wait"]["p95"],
+        "tora_critical_depth": report["depth"],
+        "tora_width_mean": report["width_mean"],
+        "tora_width_max": report["width_max"],
+        "tora_execs": report["execs"],
+        "tora_flows": report["flows"],
+        "tora_trace_lost": td.lost,
+    }))
+
+
 def tor_churn_worker():
     """Secondary metric: the Tor workload under relay churn — a fifth of
     the relays crash and restart on a 20 s cycle (the dynamic-overlay
@@ -1288,6 +1365,21 @@ def tor_rt():
     tgen_fr = _run("--tgen-worker", "tgen_", "tgen_frontier",
                    {"BENCH_FRONTIER": frontier,
                     "BENCH_RUNAHEAD_MS": runahead})
+    # the analytics row: same tier, frontier drain, --stats histograms
+    # + trace on (untimed — instrumentation changes the program, so it
+    # never contaminates the four timed legs above)
+    ana = _run("--tor-analytics-worker", "tora_", "tor_analytics",
+               {"BENCH_FRONTIER": frontier})
+    if ana:
+        depth = int(ana.get("critical_depth", 0))
+        execs = int(ana.get("execs", 0))
+        print(f"tor_rt: frontier run length p50/p95 = "
+              f"{ana.get('runlen_p50', 0):.0f}/"
+              f"{ana.get('runlen_p95', 0):.0f} positions "
+              f"(mean {ana.get('runlen_mean', 0)}), critical-path "
+              f"depth {depth} over {execs} events -> lockstep ceiling "
+              f"{execs / max(depth, 1):.1f} events/sweep",
+              file=sys.stderr, flush=True)
 
     prev_label, prev = previous_tor_record()
     if prev_label and tor_fr:
@@ -1361,6 +1453,7 @@ def print_delta(out: dict) -> None:
 
 def main():
     for flag, fn in (("--tor-worker", tor_worker),
+                     ("--tor-analytics-worker", tor_analytics_worker),
                      ("--tor-churn-worker", tor_churn_worker),
                      ("--tgen-worker", tgen_worker),
                      ("--tor-rt", tor_rt),

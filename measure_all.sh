@@ -77,6 +77,75 @@ done
 # must equal the run summary and the in-band [metrics] rows exactly.
 run metrics_smoke 900 --metrics-smoke-worker JAX_PLATFORMS=cpu \
   BENCH_BUDGET_S=840
+# sim-analytics smoke (docs/15-Sim-Analytics.md): three gates in one
+# stage — (1) a stats=0 build lowers byte-identically to a build that
+# never heard of the stat plane (the shared assert_zero_cost pin), (2)
+# a real --stats CLI run's cumulative [stats] heartbeat rows reconcile
+# exactly with its end-of-run summary histograms, and (3) the
+# OpenMetrics histogram exposition rebuilt from that run's final row
+# passes tools/check_openmetrics (monotone le, mandatory +Inf,
+# _count/_sum reconciliation). One JSON line joins $R.
+echo "=== stats_smoke start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"stats_smoke\"}" >> "$R"
+timeout 900 env JAX_PLATFORMS=cpu python - >> "$R" 2>> "$S" <<'PYEOF'
+import json, subprocess, sys, tempfile
+import jax.numpy as jnp
+from shadow_tpu.analysis.hlo_audit import assert_zero_cost
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.models import phold
+from shadow_tpu.obs.metrics import MetricsRegistry
+from shadow_tpu.obs.stats import FAMILY_KEYS, parse_hist
+from shadow_tpu.tools.parse_shadow import parse_lines
+
+# gate 1: --stats off is byte-identical to a stats-naive build
+eng0, i0 = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+engz, iz = phold.build(8, seed=3, capacity=32, msgs_per_host=2, stats=0)
+engs, i1 = phold.build(8, seed=3, capacity=32, msgs_per_host=2, stats=1)
+assert_zero_cost((eng0, i0()), (engz, iz()), (engs, i1()),
+                 jnp.int64(SECOND), get_subtree=lambda st: st.splane)
+
+# gate 2: a --stats run's [stats] rows reconcile with the summary
+run = subprocess.run(
+    [sys.executable, "-m", "shadow_tpu", "--test", "--stoptime", "6",
+     "--heartbeat-frequency", "3", "--stats"],
+    capture_output=True, text=True, timeout=600)
+assert run.returncode == 0, run.stderr[-2000:]
+summary = next(json.loads(ln) for ln in
+               reversed(run.stdout.strip().splitlines())
+               if ln.startswith("{"))
+rows = parse_lines(run.stdout.splitlines())["stats"]
+assert rows["ticks"], "no [stats] heartbeat rows"
+for fam in FAMILY_KEYS:
+    assert rows[f"{fam}_count"][-1] == summary["stats"][fam]["count"], fam
+    assert rows[f"{fam}_sum"][-1] == summary["stats"][fam]["sum"], fam
+
+# gate 3: the histogram exposition from the final row validates
+reg = MetricsRegistry(version="smoke")
+reg.ingest_stats({
+    **{f"{k}_bucket": parse_hist("|".join(
+        f"{i}:{c}" for i, c in sorted(rows[f"{k}_hist"][-1].items(),
+                                      key=lambda kv: int(kv[0]))))
+       for k in FAMILY_KEYS},
+    **{f"{k}_sum": rows[f"{k}_sum"][-1] for k in FAMILY_KEYS},
+})
+with tempfile.NamedTemporaryFile(
+        "w", suffix=".metrics", delete=False) as f:
+    f.write(reg.render())
+chk = subprocess.run(
+    [sys.executable, "-m", "shadow_tpu.tools.check_openmetrics",
+     f.name], capture_output=True, text=True)
+assert chk.returncode == 0, chk.stdout
+
+print(json.dumps({
+    "stats_zero_cost": True,
+    "stats_rows": len(rows["ticks"]),
+    "stats_reconcile": True,
+    "openmetrics": chk.stderr.strip(),
+    "wait_count": summary["stats"]["wait"]["count"],
+    "wait_p95_ns": summary["stats"]["wait"]["p95"],
+}))
+PYEOF
+echo "=== stats_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 # perf smoke: a small CPU-backend PHOLD plus a small tgen TCP workload
 # under the frontier drain, each against its checked-in PERF_FLOOR.json
 # floor — fails (exit 1) when either events/s regresses more than 30%.

@@ -218,6 +218,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "single-fetch harvest bundle — no extra device "
                         "round-trips; off, the compiled program is "
                         "byte-identical")
+    p.add_argument("--stats", action="store_true",
+                   help="sim-time analytics plane: device-side log2 "
+                        "histograms of event wait time, network latency, "
+                        "per-window host occupancy, queue fill at pop, "
+                        "and frontier run length, accumulated inside the "
+                        "jitted window loop and harvested through the "
+                        "single-fetch heartbeat bundle; emits a [stats] "
+                        "heartbeat section and OpenMetrics histogram "
+                        "families (docs/15-Sim-Analytics.md). Off, the "
+                        "compiled program is byte-identical")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve /metrics (OpenMetrics), /healthz, and "
                         "/summary.json on 127.0.0.1:PORT from a background "
@@ -590,7 +600,7 @@ def main(argv=None) -> int:
                 int(args.runahead * MILLISECOND)
                 if args.runahead is not None else None
             ),
-            trace=args.trace, profiler=prof,
+            trace=args.trace, stats=int(args.stats), profiler=prof,
             overflow=overflow,
             host_order=resume_host_order,
         )
@@ -1110,6 +1120,8 @@ def main(argv=None) -> int:
                 if metrics_on:
                     registry.ingest(summary_now, extras=metrics_extras,
                                     fill=float(fetched["fill"]))
+                    if "stats" in fetched:
+                        registry.ingest_stats(fetched["stats"])
                     registry.observe(
                         watchdog_margin_s=stall_margin,
                         checkpoints=sup_hb.checkpoints_written,
@@ -1316,6 +1328,24 @@ def main(argv=None) -> int:
         }
     if prof is not None:
         summary["profile"] = prof.summary()
+    if st.splane is not None:
+        from shadow_tpu.obs.stats import (
+            FAMILY_KEYS, stats_device_refs, summarize,
+        )
+
+        stats_fetched = jax.device_get(stats_device_refs(st.splane))  # shadowlint: no-deadline=post-loop summary; watchdogs released, state materialized
+        final_stats = summarize(stats_fetched)
+        summary["stats"] = {
+            k: {"count": final_stats[k]["count"],
+                "sum": final_stats[k]["sum"],
+                "p50": final_stats[k]["p50"],
+                "p95": final_stats[k]["p95"]}
+            for k in FAMILY_KEYS
+        }
+        if metrics_on:
+            # align the last scrape's histogram families with the
+            # printed totals, like registry.finalize below
+            registry.ingest_stats(stats_fetched)
     if xprof_span is not None:
         summary["xprof"] = {"dir": args.xprof_dir,
                             "start": xprof_span[0],

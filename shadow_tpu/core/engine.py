@@ -241,6 +241,10 @@ class EngineState:
     # unsharded — None contributes zero pytree leaves, so single-device
     # programs and checkpoints are untouched by the sharded overlap
     xchg: Any = None
+    # sim-time analytics histograms (shadow_tpu.obs.stats.StatPlane)
+    # or None when EngineConfig.stats == 0 — None contributes zero
+    # pytree leaves, same zero-cost discipline as `trace`
+    splane: Any = None
 
 
 def state_summary(state: EngineState) -> dict:
@@ -359,6 +363,14 @@ class EngineConfig:
     # member. 0 (the default) compiles the frontier path away entirely:
     # the lowered program is byte-identical to a knob-free build.
     frontier: int = 0
+    # Sim-time analytics plane (shadow_tpu.obs.stats): when > 0 the
+    # window loop streams log2 histograms of event wait time, network
+    # latency, per-window host occupancy, queue fill at pop, and
+    # frontier run length into device-array StatPlane leaves, across
+    # all three drain contracts. 0 (the default) compiles the plane
+    # away entirely — EngineState.splane is None (a leaf-free pytree
+    # subtree), the same zero-cost discipline as `trace`/`spill`.
+    stats: int = 0
 
     def __post_init__(self):
         if self.kernel not in ("xla", "pallas"):
@@ -397,6 +409,8 @@ class EngineConfig:
             )
         if self.frontier < 0:
             raise ValueError(f"frontier must be >= 0, got {self.frontier}")
+        if self.stats < 0:
+            raise ValueError(f"stats must be >= 0, got {self.stats}")
         if self.stage_width and self.stage_width < self.eff_drain_batch + self.max_emit:
             # staging must hold a full frontier dump plus one handler's
             # emits, or the chained drain could stall with zero headroom
@@ -530,6 +544,9 @@ class Engine:
         # device-side event tracing: a static flag like the CPU/jitter
         # paths — trace=0 builds carry no ring and compile no appends
         self._trace = cfg.trace > 0
+        # sim-time analytics histograms: same static-flag discipline;
+        # stats=0 builds carry no StatPlane and compile no observes
+        self._stats = cfg.stats > 0
         # fault schedule: static sub-flags keep the no-fault (and
         # partial-fault) compiled programs free of dead overlay work
         self.faults = faults
@@ -749,6 +766,11 @@ class Engine:
         xchg = None
         if cfg.axis_name is not None:
             xchg = ExchangeBuf.create(cfg.n_shards, self._xchg_r, cfg.n_args)
+        splane = None
+        if self._stats:
+            from shadow_tpu.obs.stats import StatPlane
+
+            splane = StatPlane.create(cfg.n_hosts)
         return EngineState(
             now=jnp.zeros((), jnp.int64),
             queues=q,
@@ -760,6 +782,7 @@ class Engine:
             fault_epoch=jnp.zeros((), jnp.int32),
             trace=trace,
             xchg=xchg,
+            splane=splane,
         )
 
     # -- fault-schedule helpers ---------------------------------------------
@@ -870,13 +893,15 @@ class Engine:
     # -- execute one frontier position across all hosts ---------------------
     def _execute_step(self, hosts, src_seq, exec_cnt, stats, ev: Events,
                       active: jax.Array, window_end: jax.Array,
-                      gids: jax.Array, trace=None):
+                      gids: jax.Array, trace=None, splane=None):
         """Run handlers for one event per host (masked), route the emits.
 
         Returns (hosts', src_seq', exec_cnt', stats', routed Events[H, K],
-        final_mask[H, K], trace'). `trace` passes through untouched
-        (None) unless tracing is compiled in, in which case one append
-        records the executed event plus every non-local emit.
+        final_mask[H, K], trace', splane'). `trace` passes through
+        untouched (None) unless tracing is compiled in, in which case
+        one append records the executed event plus every non-local
+        emit; `splane` likewise accumulates the wait/net histograms
+        only when the stats plane is compiled in.
         """
         cfg = self.cfg
         h, k = cfg.n_hosts, cfg.max_emit
@@ -909,6 +934,14 @@ class Engine:
         out, final_mask, dropped, fdropped, _t, _is_local = self._route(
             emit, ev.time, gids, window_end, rkeys, emask, seq
         )
+
+        if self._stats and splane is not None:
+            # every delivered emit executes at its routed time _t, so
+            # _t - now IS exec-minus-enqueue sim time; the non-local
+            # subset is the send->exec network latency
+            delta = _t - ev.time[:, None]
+            splane = splane.observe("wait", delta, final_mask)
+            splane = splane.observe("net", delta, final_mask & ~_is_local)
 
         if self._trace and trace is not None:
             from shadow_tpu.obs.trace import (
@@ -962,7 +995,8 @@ class Engine:
                 * active[:, None]
             ),
         )
-        return hosts, src_seq, exec_cnt, stats, out, final_mask, trace
+        return (hosts, src_seq, exec_cnt, stats, out, final_mask, trace,
+                splane)
 
     # -- commutative fast path: whole frontiers in one vmapped call ---------
     def _drain_window_batched(self, st: EngineState, window_end, host0):
@@ -986,7 +1020,8 @@ class Engine:
             return carry[0]
 
         def outer_body(carry):
-            _, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace,
+             splane) = carry
             # merge window k-1's in-flight exchange before reading the
             # frontier: the gap since the sending sweep's push contains
             # no queue operation, so deferred delivery is bit-identical
@@ -997,6 +1032,15 @@ class Engine:
             bvalid = bt < window_end  # a prefix: rows are key-sorted
             if self._cpu_enabled:
                 bvalid = bvalid & (cpu_free[:, None] < window_end)
+            if self._stats and splane is not None:
+                # queue fill at pop, pre-clear (chained-drain semantics:
+                # hosts popping at least one event this sweep)
+                splane = splane.observe(
+                    "qfill",
+                    jnp.sum(q.time != TIME_INVALID, axis=1,
+                            dtype=jnp.int64),
+                    jnp.any(bvalid, axis=1),
+                )
             # crashed hosts consume (quarantine) their frontier without
             # executing it: rows still clear below, handlers see
             # TIME_INVALID
@@ -1047,6 +1091,16 @@ class Engine:
                 flat(emask),
                 flat(seq),
             )
+
+            if self._stats and splane is not None:
+                delta = (_t - evs.time.reshape(-1)[:, None]).reshape(
+                    h, b * k
+                )
+                fm = final_mask.reshape(h, b * k)
+                splane = splane.observe("wait", delta, fm)
+                splane = splane.observe(
+                    "net", delta, fm & ~_loc.reshape(h, b * k)
+                )
 
             if self._trace and trace is not None:
                 from shadow_tpu.obs.trace import (
@@ -1142,18 +1196,21 @@ class Engine:
             )
             more = self._drain_flag(q, cpu_free, window_end)
             return (more, q, xchg, hosts, src_seq, exec_cnt, stats2,
-                    cpu_free, trace)
+                    cpu_free, trace, splane)
 
         carry = (self._drain_flag(st.queues, st.cpu_free, window_end),
                  st.queues, st.xchg, st.hosts, st.src_seq, st.exec_cnt,
-                 st.stats, st.cpu_free, st.trace)
+                 st.stats, st.cpu_free, st.trace, st.splane)
         (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free,
-         trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
+         trace, splane) = jax.lax.while_loop(outer_cond, outer_body, carry)
         if self._cpu_enabled:
             # the barrier's sent_min shortcut cannot see a destination
             # host's busy CPU; flush in-flight events before `_next_time`
             # runs so the max(min_time, cpu_free) defer stays exact
             q, xchg = self._xchg_deliver(q, xchg, host0)
+        if self._stats and splane is not None:
+            occ = stats.n_executed - st.stats.n_executed
+            splane = splane.observe("occ", occ, occ > 0)
         return dataclasses.replace(
             st,
             queues=q,
@@ -1164,6 +1221,7 @@ class Engine:
             cpu_free=cpu_free,
             trace=trace,
             xchg=xchg,
+            splane=splane,
         )
 
     # -- staging-buffer helpers (chained drain) ------------------------------
@@ -1376,7 +1434,8 @@ class Engine:
             return carry[0]
 
         def outer_body(carry):
-            _, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace,
+             splane) = carry
             # merge the previous sweep's in-flight exchange before the
             # frontier read: no queue op ran since its sending push, so
             # the deferred merge is bit-identical to an immediate one
@@ -1388,6 +1447,15 @@ class Engine:
             # columns, and clearing them is a prefix compare — no scatter.
             bvalid = q.time[:, :b] < window_end  # a prefix of each row
             ndump = jnp.sum(bvalid, axis=1, dtype=jnp.int32)
+            if self._stats and splane is not None:
+                # queue fill at pop: how full each popping host's queue
+                # is the moment its frontier dumps (pre-clear)
+                splane = splane.observe(
+                    "qfill",
+                    jnp.sum(q.time != TIME_INVALID, axis=1,
+                            dtype=jnp.int64),
+                    ndump > 0,
+                )
             pad = ((0, 0), (0, sw - b))
             stage = Events(
                 time=jnp.pad(
@@ -1458,7 +1526,7 @@ class Engine:
 
             def inner_body(ic):
                 (_, sm, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
-                 trace) = ic
+                 trace, splane) = ic
                 ev, mss, onehot, cnt = sm
                 ev_t = ev.time
                 eff_t = (
@@ -1495,11 +1563,10 @@ class Engine:
                     time=jnp.where(runm, eff_t, TIME_INVALID),
                     dst=gids,
                 )
-                hosts, src_seq, exec_cnt, stats, out, _fmask, trace = (
-                    self._execute_step(
-                        hosts, src_seq, exec_cnt, stats, ev, runm,
-                        window_end, gids, trace,
-                    )
+                (hosts, src_seq, exec_cnt, stats, out, _fmask, trace,
+                 splane) = self._execute_step(
+                    hosts, src_seq, exec_cnt, stats, ev, runm,
+                    window_end, gids, trace, splane,
                 )
                 if self._cpu_enabled:
                     ev_cost = _kind_cost(cpu_cost, ev.kind)
@@ -1527,15 +1594,15 @@ class Engine:
                 )
                 sm2 = self._stage_min(stage)
                 return (can_run(sm2, cpu_free), sm2, stage, hosts, src_seq,
-                        exec_cnt, stats, cpu_free, trace)
+                        exec_cnt, stats, cpu_free, trace, splane)
 
             sm0 = self._stage_min(stage)
             (_, _, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
-             trace) = jax.lax.while_loop(
+             trace, splane) = jax.lax.while_loop(
                 inner_cond,
                 inner_body,
                 (can_run(sm0, cpu_free), sm0, stage, hosts, src_seq,
-                 exec_cnt, stats, cpu_free, trace),
+                 exec_cnt, stats, cpu_free, trace, splane),
             )
 
             # 3. flush staging leftovers (clamped remote sends, far-future
@@ -1599,13 +1666,13 @@ class Engine:
             )
             more = self._drain_flag(q, cpu_free, window_end)
             return (more, q, xchg, hosts, src_seq, exec_cnt, stats,
-                    cpu_free, trace)
+                    cpu_free, trace, splane)
 
         carry = (self._drain_flag(st.queues, st.cpu_free, window_end),
                  st.queues, st.xchg, st.hosts, st.src_seq, st.exec_cnt,
-                 st.stats, st.cpu_free, st.trace)
+                 st.stats, st.cpu_free, st.trace, st.splane)
         (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free,
-         trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
+         trace, splane) = jax.lax.while_loop(outer_cond, outer_body, carry)
         if self._cpu_enabled:
             # sent_min cannot see a destination's busy CPU: flush the
             # in-flight buffer before `_next_time`'s cpu_free defer runs
@@ -1615,6 +1682,11 @@ class Engine:
         inner = st.stats.n_inner_steps + self._gsum(
             stats.n_inner_steps - st.stats.n_inner_steps
         )
+        if self._stats and splane is not None:
+            # per-window occupancy: events each host executed this
+            # window (hosts that ran nothing contribute no sample)
+            occ = stats.n_executed - st.stats.n_executed
+            splane = splane.observe("occ", occ, occ > 0)
         return dataclasses.replace(
             st,
             queues=q,
@@ -1627,6 +1699,7 @@ class Engine:
             cpu_free=cpu_free,
             trace=trace,
             xchg=xchg,
+            splane=splane,
         )
 
     # -- frontier drain: kind-partitioned runs, per-round bookkeeping --------
@@ -1700,13 +1773,23 @@ class Engine:
             return carry[0]
 
         def outer_body(carry):
-            _, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace = carry
+            (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free, trace,
+             splane) = carry
             q, xchg = self._xchg_deliver(q, xchg, host0)
 
             # 1. frontier dump into staging — identical to the chained
             # drain (same prefix clear, same optional burst fold)
             bvalid = q.time[:, :b] < window_end
             ndump = jnp.sum(bvalid, axis=1, dtype=jnp.int32)
+            if self._stats and splane is not None:
+                # same pre-clear observation point as the chained drain,
+                # so qfill histograms are bit-identical across contracts
+                splane = splane.observe(
+                    "qfill",
+                    jnp.sum(q.time != TIME_INVALID, axis=1,
+                            dtype=jnp.int64),
+                    ndump > 0,
+                )
             pad = ((0, 0), (0, sw - b))
             stage = Events(
                 time=jnp.pad(
@@ -1755,7 +1838,8 @@ class Engine:
                 return rc[0]
 
             def round_body(rc):
-                _, stage, hosts, src_seq, exec_cnt, stats, cpu_free, trace = rc
+                (_, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
+                 trace, splane) = rc
                 skey = pack_srcseq(stage.src, stage.seq)
                 t2, ss2, dst2, src2, seq2, kind2, *acols = jax.lax.sort(
                     (stage.time, skey, stage.dst, stage.src, stage.seq,
@@ -1780,7 +1864,7 @@ class Engine:
 
                 def pos_body(pc):
                     (_, j, still, hosts, src_seq, exec_cnt, stats,
-                     cpu_free, cnt, nact, outbuf, trbuf) = pc
+                     cpu_free, cnt, nact, outbuf, trbuf, splane) = pc
                     col = lambda a: jax.lax.dynamic_index_in_dim(
                         a, j, axis=1, keepdims=False
                     )
@@ -1835,6 +1919,15 @@ class Engine:
                             seq,
                         )
                     )
+                    if self._stats and splane is not None:
+                        # same observation as _execute_step's, so the
+                        # wait/net histograms are bit-identical to the
+                        # chained drain's
+                        delta = _t - ev.time[:, None]
+                        splane = splane.observe("wait", delta, final_mask)
+                        splane = splane.observe(
+                            "net", delta, final_mask & ~_is_local
+                        )
                     if self._cpu_enabled:
                         ev_cost = _kind_cost(cpu_cost, ev.kind)
                         if cfg.burst is not None:
@@ -1924,7 +2017,8 @@ class Engine:
                         )
                     go = jnp.any(active) & (j + 1 < u)
                     return (go, j + 1, active, hosts, src_seq, exec_cnt,
-                            stats, cpu_free, cnt, nact, outbuf, trbuf)
+                            stats, cpu_free, cnt, nact, outbuf, trbuf,
+                            splane)
 
                 outbuf0 = Events(
                     time=jnp.full((h, u, k), TIME_INVALID, jnp.int64),
@@ -1943,13 +2037,18 @@ class Engine:
                         jnp.zeros((h, u, 1 + k), bool),
                     )
                 (_, jn, _still, hosts, src_seq, exec_cnt, stats, cpu_free,
-                 _cnt, nact, outbuf, trbuf) = jax.lax.while_loop(
+                 _cnt, nact, outbuf, trbuf, splane) = jax.lax.while_loop(
                     pos_cond, pos_body,
                     (jnp.asarray(True), jnp.zeros((), jnp.int32),
                      jnp.ones((h,), bool), hosts, src_seq, exec_cnt,
                      stats, cpu_free, cnt0, jnp.zeros((h,), jnp.int32),
-                     outbuf0, trbuf0),
+                     outbuf0, trbuf0, splane),
                 )
+                if self._stats and splane is not None:
+                    # frontier run length: how many positions each host
+                    # actually executed this round — the quantity that
+                    # decides whether the per-round sort amortizes
+                    splane = splane.observe("runlen", nact, nact > 0)
 
                 # 3. per-round bookkeeping: prefix-clear the executed
                 # columns, one deferred append of every position's routed
@@ -1991,14 +2090,14 @@ class Engine:
                 )
                 sm2 = self._stage_min(stage)
                 return (can_run(sm2, cpu_free), stage, hosts, src_seq,
-                        exec_cnt, stats, cpu_free, trace)
+                        exec_cnt, stats, cpu_free, trace, splane)
 
             sm0 = self._stage_min(stage)
             (_, stage, hosts, src_seq, exec_cnt, stats, cpu_free,
-             trace) = jax.lax.while_loop(
+             trace, splane) = jax.lax.while_loop(
                 round_cond, round_body,
                 (can_run(sm0, cpu_free), stage, hosts, src_seq, exec_cnt,
-                 stats, cpu_free, trace),
+                 stats, cpu_free, trace, splane),
             )
 
             # 4. flush staging leftovers — identical to the chained drain
@@ -2052,18 +2151,21 @@ class Engine:
             )
             more = self._drain_flag(q, cpu_free, window_end)
             return (more, q, xchg, hosts, src_seq, exec_cnt, stats,
-                    cpu_free, trace)
+                    cpu_free, trace, splane)
 
         carry = (self._drain_flag(st.queues, st.cpu_free, window_end),
                  st.queues, st.xchg, st.hosts, st.src_seq, st.exec_cnt,
-                 st.stats, st.cpu_free, st.trace)
+                 st.stats, st.cpu_free, st.trace, st.splane)
         (_, q, xchg, hosts, src_seq, exec_cnt, stats, cpu_free,
-         trace) = jax.lax.while_loop(outer_cond, outer_body, carry)
+         trace, splane) = jax.lax.while_loop(outer_cond, outer_body, carry)
         if self._cpu_enabled:
             q, xchg = self._xchg_deliver(q, xchg, host0)
         inner = st.stats.n_inner_steps + self._gsum(
             stats.n_inner_steps - st.stats.n_inner_steps
         )
+        if self._stats and splane is not None:
+            occ = stats.n_executed - st.stats.n_executed
+            splane = splane.observe("occ", occ, occ > 0)
         return dataclasses.replace(
             st,
             queues=q,
@@ -2076,6 +2178,7 @@ class Engine:
             cpu_free=cpu_free,
             trace=trace,
             xchg=xchg,
+            splane=splane,
         )
 
     def _next_time(self, st: EngineState) -> jax.Array:

@@ -143,6 +143,7 @@ def build(
     drain_batch: int = 24,
     batched: bool = False,
     trace: int = 0,
+    stats: int = 0,
     spill: int = 0,
     kernel: str = "xla",
 ):
@@ -164,6 +165,7 @@ def build(
         n_shards=n_shards,
         drain_batch=drain_batch,
         trace=trace,
+        stats=stats,
         spill=spill,
         kernel=kernel,
     )

@@ -183,6 +183,21 @@ _FINAL_COUNTERS = {
     "tx_bytes": _P + "tx_bytes",
 }
 
+# --stats histogram families (obs.stats.FAMILIES): exposed with the
+# standard OpenMetrics histogram triplet — cumulative `_bucket` samples
+# with `le` labels, `_sum`, `_count`. Rendered only once a stats bundle
+# has been ingested, so a stats-off run's exposition is unchanged.
+def _hist_specs():
+    from shadow_tpu.obs.stats import FAMILIES
+
+    return tuple(
+        (key, MetricSpec(_P + name, "histogram", help_ + ".",
+                         f"StatPlane.{key}_n/.{key}_s via the harvest "
+                         "stats bundle"))
+        for key, name, help_ in FAMILIES
+    )
+
+
 # the [metrics] tracker heartbeat row: cumulative registry totals (NOT
 # interval deltas like [node]) so a scrape, the tracker line, and the
 # end-of-run summary are directly comparable
@@ -247,6 +262,10 @@ class MetricsRegistry:
                                      if s.name != _P + "phase_seconds"
                                      and s.name != _P + "phase_calls"}
         self._phases: dict[str, dict] = {}
+        # --stats histograms: family key -> (bucket counts [NB], sum).
+        # Empty until the first ingest_stats, so stats-off expositions
+        # carry no histogram families at all.
+        self._hist: dict[str, tuple] = {}
         self._labels = {"version": version or "unknown"}
         self._v[_P + "shards"] = float(max(int(n_shards), 1))
         self._v[_P + "build_info"] = 1.0
@@ -277,6 +296,19 @@ class MetricsRegistry:
             if fill is not None:
                 self._v[_P + "queue_fill"] = _num(fill)
             self._v[_P + "heartbeats"] += 1.0
+
+    def ingest_stats(self, stats_fetched: dict) -> None:
+        """Fold one fetched --stats bundle (obs.stats.stats_device_refs
+        after device_get) in: cumulative per-family bucket vectors and
+        value sums, replacing the previous beat's (the StatPlane
+        accumulates on device, so each fetch is already a running
+        total)."""
+        from shadow_tpu.obs.stats import FAMILY_KEYS
+
+        with self._lock:
+            for k in FAMILY_KEYS:
+                buckets = [int(v) for v in stats_fetched[f"{k}_bucket"]]
+                self._hist[k] = (buckets, int(stats_fetched[f"{k}_sum"]))
 
     def observe(self, *, watchdog_margin_s: float | None = None,
                 checkpoints: int | None = None,
@@ -332,6 +364,14 @@ class MetricsRegistry:
                    for k, v in sorted(self._v.items())}
             for name, agg in sorted(self._phases.items()):
                 out[f"{_P}phase_seconds{{phase={name}}}"] = agg["seconds"]
+            if self._hist:
+                from shadow_tpu.obs.stats import FAMILIES
+
+                for key, name, _ in FAMILIES:
+                    if key in self._hist:
+                        buckets, total = self._hist[key]
+                        out[f"{_P}{name}_count"] = sum(buckets)
+                        out[f"{_P}{name}_sum"] = total
         return out
 
     def metrics_row(self, t_s: int) -> str:
@@ -354,6 +394,7 @@ class MetricsRegistry:
         with self._lock:
             values = dict(self._v)
             phases = {k: dict(v) for k, v in sorted(self._phases.items())}
+            hist = {k: (list(b), s) for k, (b, s) in self._hist.items()}
         lines: list[str] = []
         for spec in SPECS:
             lines.append(f"# TYPE {spec.name} {spec.kind}")
@@ -373,6 +414,22 @@ class MetricsRegistry:
             else:
                 lines.append(
                     f"{spec.name}{suffix} {_fmt(values[spec.name])}")
+        if hist:
+            from shadow_tpu.obs.stats import BUCKET_LE_LABELS
+
+            for key, spec in _hist_specs():
+                if key not in hist:
+                    continue
+                buckets, total_sum = hist[key]
+                lines.append(f"# TYPE {spec.name} histogram")
+                lines.append(f"# HELP {spec.name} {spec.help}")
+                cum = 0
+                for le, n in zip(BUCKET_LE_LABELS, buckets):
+                    cum += n
+                    lines.append(
+                        f'{spec.name}_bucket{{le="{le}"}} {cum}')
+                lines.append(f"{spec.name}_sum {total_sum}")
+                lines.append(f"{spec.name}_count {cum}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
@@ -382,10 +439,16 @@ def validate_openmetrics(text: str) -> list[str]:
     Returns a list of violations; empty means the exposition is
     well-formed: TYPE-before-samples, known kinds, counter samples
     suffixed `_total`, parseable values, no duplicate samples, and a
-    final `# EOF` line."""
+    final `# EOF` line. Histogram families get the full semantic
+    check: samples only via `_bucket`/`_sum`/`_count` suffixes,
+    `le`-labelled buckets in strictly increasing `le` order with
+    non-decreasing cumulative counts, a mandatory `+Inf` bucket, and
+    `_count` equal to the `+Inf` bucket's value."""
     errors: list[str] = []
     kinds: dict[str, str] = {}
     seen: set[str] = set()
+    # histogram family -> {"buckets": [(le, value)], "sum": x, "count": x}
+    hist: dict[str, dict] = {}
     lines = text.split("\n")
     if not lines or lines[-1] != "" or len(lines) < 2 \
             or lines[-2] != "# EOF":
@@ -411,6 +474,13 @@ def validate_openmetrics(text: str) -> list[str]:
         left, _, value = line.rpartition(" ")
         name = left.split("{", 1)[0]
         family = name[:-6] if name.endswith("_total") else name
+        # histogram samples resolve to their family by suffix
+        hist_suffix = None
+        for suf in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suf)] if name.endswith(suf) else None
+            if base and kinds.get(base) == "histogram":
+                family, hist_suffix = base, suf
+                break
         if family not in kinds:
             errors.append(f"line {i}: sample {name!r} before its TYPE")
             continue
@@ -421,12 +491,60 @@ def validate_openmetrics(text: str) -> list[str]:
             errors.append(f"line {i}: gauge sample {name!r} must not "
                           "end with _total")
         try:
-            float(value)
+            val = float(value)
         except ValueError:
             errors.append(f"line {i}: unparseable value {value!r}")
+            val = None
+        if kinds[family] == "histogram":
+            h = hist.setdefault(
+                family, {"buckets": [], "sum": None, "count": None})
+            if hist_suffix is None:
+                errors.append(
+                    f"line {i}: histogram sample {name!r} must use a "
+                    "_bucket/_sum/_count suffix")
+            elif hist_suffix == "_bucket":
+                m = left.split('le="', 1)
+                if len(m) != 2 or '"' not in m[1]:
+                    errors.append(f"line {i}: histogram bucket without "
+                                  f"an le label: {line!r}")
+                elif val is not None:
+                    le_s = m[1].split('"', 1)[0]
+                    le = (float("inf") if le_s == "+Inf"
+                          else float(le_s))
+                    h["buckets"].append((le, val))
+            elif val is not None:
+                h[hist_suffix[1:]] = val
         if left in seen:
             errors.append(f"line {i}: duplicate sample {left!r}")
         seen.add(left)
+    for family, kind in kinds.items():
+        if kind != "histogram":
+            continue
+        h = hist.get(family)
+        if h is None:
+            errors.append(f"histogram {family!r} declared but has no "
+                          "samples")
+            continue
+        buckets = h["buckets"]
+        les = [le for le, _ in buckets]
+        if les != sorted(les) or len(set(les)) != len(les):
+            errors.append(f"histogram {family!r}: le labels not "
+                          "strictly increasing")
+        vals = [v for _, v in buckets]
+        if vals != sorted(vals):
+            errors.append(f"histogram {family!r}: cumulative bucket "
+                          "counts decrease")
+        if not les or les[-1] != float("inf"):
+            errors.append(f"histogram {family!r}: missing mandatory "
+                          "+Inf bucket")
+        elif h["count"] is not None and h["count"] != vals[-1]:
+            errors.append(
+                f"histogram {family!r}: _count {h['count']} != +Inf "
+                f"bucket {vals[-1]}")
+        if h["count"] is None:
+            errors.append(f"histogram {family!r}: missing _count")
+        if h["sum"] is None:
+            errors.append(f"histogram {family!r}: missing _sum")
     return errors
 
 

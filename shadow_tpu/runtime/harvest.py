@@ -81,6 +81,7 @@ class HeartbeatHarvest:
         has_pcap = pcap is not None and sim.state0.hosts.net.cap is not None
         has_ring = sim.state0.queues.spill is not None
         has_metrics = self.metrics is not None
+        has_stats = sim.state0.splane is not None
 
         def extract(state):
             q = state.queues
@@ -111,6 +112,13 @@ class HeartbeatHarvest:
                 # a handful of extra global reductions riding the same
                 # single fetch — the exporter's live counters
                 bundle["metrics"] = metrics_device_refs(state)
+            if has_stats:
+                from shadow_tpu.obs.stats import stats_device_refs
+
+                # global (host-summed) histogram reductions, computed on
+                # device so sharded runs fetch exact totals through the
+                # same single transfer as the rest of the bundle
+                bundle["stats"] = stats_device_refs(state.splane)
             if full:
                 if tracker is not None:
                     bundle["tracker"] = tracker.gather(state)
@@ -183,5 +191,7 @@ class HeartbeatHarvest:
             self.tdrain.ingest(fetched["trace"])
         if self.tracker is not None and "tracker" in fetched:
             self.tracker.heartbeat_from(fetched["tracker"], sim_ns)
+        if self.tracker is not None and "stats" in fetched:
+            self.tracker.stats_from(fetched["stats"], sim_ns)
         if self.pcap is not None and "pcap" in fetched:
             self.pcap.ingest(fetched["pcap"])

@@ -737,6 +737,7 @@ def build_simulation(
     burst_rx: bool = True,
     shape_bucket: bool = True,
     trace: int = 0,
+    stats: int = 0,
     profiler: Any = None,
     overflow: str = "drop",
     spill_len: int = 0,
@@ -766,6 +767,13 @@ def build_simulation(
     checkpoint's leaves regardless of the new mesh's shard count. It
     overrides `locality` (the stored order already IS the writer's
     locality layout) and is legal on any mesh, including unsharded.
+
+    `stats` (docs/15-Sim-Analytics.md) compiles the sim-time analytics
+    plane into the window loop: device-side log2 histograms of event
+    wait time, network latency, per-window host occupancy, queue fill,
+    and frontier run length (`EngineState.splane`, harvested through
+    the heartbeat bundle's single fetch). 0 (the default) is zero-cost:
+    the lowered program is byte-identical to a stats-free build.
 
     `frontier` (docs/11-Performance.md, "Model-tier batching") selects
     the engine's third drain contract: per round each host's staged
@@ -1199,7 +1207,7 @@ def build_simulation(
         max_emit=max_emit, n_args=N_PKT_ARGS, seed=seed,
         axis_name=axis_name, n_shards=n_shards, burst=burst,
         trace=int(trace), trace_len_arg=int(_A_LEN),
-        spill=spill, frontier=int(frontier),
+        spill=spill, frontier=int(frontier), stats=int(stats),
     )
     network = topo.build_network(host_vertex)
     # per-KIND CPU charges: a model may declare cycle costs for specific

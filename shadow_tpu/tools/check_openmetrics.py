@@ -1,9 +1,13 @@
 """check_openmetrics: lint an OpenMetrics exposition for syntax errors.
 
 Thin CLI over `shadow_tpu.obs.metrics.validate_openmetrics` so shell
-harnesses (measure_all.sh's metrics_smoke stage) can gate on exporter
-output without a prometheus toolchain in the container. Reads a scrape
-from a file or stdin; prints one violation per line and exits 1 on any.
+harnesses (measure_all.sh's metrics_smoke / stats_smoke stages) can
+gate on exporter output without a prometheus toolchain in the
+container. Histogram families (the --stats expositions) get the full
+semantic check: monotone `le` bucket ordering, the mandatory `+Inf`
+bucket, and `_count`/`_sum` reconciliation against the bucket totals.
+Reads a scrape from a file or stdin; prints one violation per line and
+exits 1 on any.
 
 Usage:
     curl -s localhost:PORT/metrics | python -m \
@@ -38,7 +42,13 @@ def main(argv=None) -> int:
             1 for ln in text.splitlines()
             if ln and not ln.startswith("#")
         )
-        print(f"ok: {n} samples", file=sys.stderr)
+        n_hist = sum(
+            1 for ln in text.splitlines()
+            if ln.startswith("# TYPE ") and ln.endswith(" histogram")
+        )
+        print(f"ok: {n} samples"
+              + (f", {n_hist} histogram families" if n_hist else ""),
+              file=sys.stderr)
     return 1 if problems else 0
 
 

@@ -70,6 +70,18 @@ METRICS_FIELDS = (
     "heartbeats",
 )
 
+# whole-run [stats] rows (only with --stats): per histogram family the
+# CUMULATIVE sample count, value sum, p50/p95, and the sparse bucket
+# spec ("idx:count|..."), decoded here into {bucket-index: count} so
+# plot_shadow can rebuild the full log2 distributions from the log
+# alone. Column names come from the [stats-header] row when present
+# (forward-compatible with new families); this is the current default.
+STATS_FAMILIES = ("wait", "net", "occ", "qfill", "runlen")
+STATS_COLS = tuple(
+    f"{k}_{c}" for k in STATS_FAMILIES
+    for c in ("count", "sum", "p50", "p95", "hist")
+)
+
 
 def _sort_series(series: dict, key: str = "ticks") -> None:
     """Stable-sort one tick-keyed column store in place. Heartbeat
@@ -101,6 +113,10 @@ def parse_lines(lines) -> dict:
     metrics: dict[str, list] = {
         "ticks": [], **{f: [] for f in METRICS_FIELDS}
     }
+    stats: dict[str, list] = {
+        "ticks": [], **{f: [] for f in STATS_COLS}
+    }
+    stats_cols: tuple[str, ...] = STATS_COLS
     for line in lines:
         if "[shadow-heartbeat] [node] " in line:
             csv = line.rsplit("[shadow-heartbeat] [node] ", 1)[1].strip()
@@ -204,10 +220,33 @@ def parse_lines(lines) -> dict:
                 metrics[f].append(
                     float(v) if f == "queue_fill" else int(v)
                 )
+        elif "[shadow-heartbeat] [stats-header] " in line:
+            csv = line.rsplit(
+                "[shadow-heartbeat] [stats-header] ", 1
+            )[1].strip()
+            cols = tuple(csv.split(",")[1:])  # drop the t_s column
+            if cols and cols != stats_cols:
+                stats_cols = cols
+                for f in cols:
+                    stats.setdefault(f, [])
+        elif "[shadow-heartbeat] [stats] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [stats] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 1 + len(stats_cols):
+                continue
+            stats["ticks"].append(float(parts[0]))
+            for f, v in zip(stats_cols, parts[1:]):
+                if f.endswith("_hist"):
+                    stats[f].append({
+                        p.split(":", 1)[0]: int(p.split(":", 1)[1])
+                        for p in v.split("|") if p
+                    })
+                else:
+                    stats[f].append(float(v) if "." in v else int(v))
     # tolerate interleaved optional sections: logs from resumed/sharded
     # runs (or concatenated shards) need not keep each section's rows
     # contiguous or tick-ordered
-    for series in (supervisor, pressure, metrics):
+    for series in (supervisor, pressure, metrics, stats):
         _sort_series(series)
     for per_name in (nodes, ram, faults, trace):
         for series in per_name.values():
@@ -216,7 +255,7 @@ def parse_lines(lines) -> dict:
         rows.sort(key=lambda r: r["time"])
     return {"nodes": nodes, "sockets": sockets, "ram": ram,
             "faults": faults, "trace": trace, "supervisor": supervisor,
-            "pressure": pressure, "metrics": metrics}
+            "pressure": pressure, "metrics": metrics, "stats": stats}
 
 
 def main(argv=None) -> int:

@@ -244,6 +244,79 @@ def make_figures(stats: dict, outdir: str, fmt: str = "png") -> list[str]:
         ax2.set_ylabel("registry - tracker")
         save(fig, "shadow_tpu.metrics")
 
+    # 9-11. --stats analytics figures — only when the run logged [stats]
+    # rows. The rows are cumulative, so the LAST row's sparse bucket
+    # specs are the run's final distributions; buckets are log2 with
+    # upper bound 2^i - 1 (obs/stats.py's scheme), drawn as bar charts
+    # over bucket index with power-of-two tick labels.
+    sts = stats.get("stats", {})
+
+    def _last_hist(fam: str) -> dict:
+        cells = sts.get(f"{fam}_hist") or []
+        return cells[-1] if cells else {}
+
+    def _bars(axis, fam: str, label: str, color=None):
+        h = _last_hist(fam)
+        if not h:
+            return False
+        idx = sorted(int(i) for i in h)
+        axis.bar(idx, [h[str(i)] for i in idx], width=0.9,
+                 label=label, alpha=0.7, color=color)
+        return True
+
+    def _log2_ticks(axis):
+        lo, hi = axis.get_xlim()
+        ticks = [i for i in range(0, 64, 8) if lo <= i <= hi]
+        axis.set_xticks(ticks)
+        axis.set_xticklabels(
+            ["0" if i == 0 else f"2^{i - 1}" for i in ticks])
+
+    if sts.get("ticks"):
+        # latency distributions: event wait + network latency
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        any_lat = _bars(ax, "wait", "event wait")
+        any_lat |= _bars(ax, "net", "net latency")
+        if any_lat:
+            _log2_ticks(ax)
+            ax.set_xlabel("ns (log2 bucket lower bound)")
+            ax.set_ylabel("events")
+            ax.set_yscale("symlog")
+            ax.set_title("sim-time latency distributions")
+            ax.legend()
+            save(fig, "shadow_tpu.stats_latency")
+        else:
+            plt.close(fig)
+
+        # occupancy distributions: events/host/window + queue fill
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        any_occ = _bars(ax, "occ", "events per host per window")
+        any_occ |= _bars(ax, "qfill", "queue fill at pop")
+        if any_occ:
+            _log2_ticks(ax)
+            ax.set_xlabel("count (log2 bucket lower bound)")
+            ax.set_ylabel("observations")
+            ax.set_yscale("symlog")
+            ax.set_title("occupancy distributions")
+            ax.legend()
+            save(fig, "shadow_tpu.stats_occupancy")
+        else:
+            plt.close(fig)
+
+        # frontier run length — the PR 13 TPU-bet measurement; only
+        # frontier-drain runs populate it
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        if _bars(ax, "runlen", "frontier run length",
+                 color="tab:green"):
+            _log2_ticks(ax)
+            ax.set_xlabel("positions/round (log2 bucket lower bound)")
+            ax.set_ylabel("rounds")
+            ax.set_yscale("symlog")
+            ax.set_title("frontier-drain run length")
+            ax.legend()
+            save(fig, "shadow_tpu.stats_runlen")
+        else:
+            plt.close(fig)
+
     return written
 
 

@@ -242,6 +242,11 @@ class Tracker:
         # the row and the [node] section describe the same extraction.
         self.metrics = metrics
         self._prev_pressure: dict | None = None
+        # --stats: the harvest hands the fetched histogram bundle to
+        # stats_from separately (it lives at the bundle top level, next
+        # to the [metrics] reductions, not inside the tracker gather)
+        self._emitted_stats_header = False
+        self._stats_prev_ns: int | None = None
         self.prev = Snapshot.zero(len(names))
         # None until the first heartbeat lands; afterwards the guard in
         # heartbeat() drops zero-length (or backwards) intervals so a
@@ -389,6 +394,34 @@ class Tracker:
             )
         self.prev = cur
         self._prev_ns = sim_ns
+
+    def stats_from(self, stats_fetched: dict, sim_ns: int) -> None:
+        """Emit one `[stats]` row from a fetched --stats histogram
+        bundle (obs.stats.stats_device_refs after device_get): per
+        family the cumulative count, value sum, p50/p95, and the sparse
+        bucket spec — enough for parse_shadow/plot_shadow to rebuild
+        the full distributions from the log alone. Cumulative like the
+        [metrics] row, so the last row reconciles with the end-of-run
+        summary."""
+        if self._stats_prev_ns is not None and \
+                sim_ns <= self._stats_prev_ns:
+            return
+        from shadow_tpu.obs.stats import (
+            STATS_HEADER, stats_row, summarize,
+        )
+
+        if not self._emitted_stats_header:
+            self.logger.log(
+                sim_ns, "tracker", "message",
+                "[shadow-heartbeat] [stats-header] " + STATS_HEADER)
+            self._emitted_stats_header = True
+        t_s = sim_ns // 1_000_000_000
+        self.logger.log(
+            sim_ns, "tracker", "message",
+            "[shadow-heartbeat] [stats] "
+            + stats_row(t_s, summarize(stats_fetched)),
+        )
+        self._stats_prev_ns = sim_ns
 
     def _pressure_line(self, fetched: dict, sim_ns: int, t_s: int) -> None:
         """One aggregate queue-pressure row per interval (like the

@@ -1,0 +1,413 @@
+"""Sim-time analytics plane: streaming histograms + attribution tools.
+
+Pins the ISSUE 14 contracts end to end:
+
+- `obs.stats.StatPlane` bucket math (bit-length indexing, percentile /
+  summarize / CSV-row round trips);
+- zero cost when off: `stats=0` lowers byte-identically to a build
+  that never heard of the stat plane (shared `assert_zero_cost`), and
+  `--stats` on adds ZERO extra device fetches — the harvest census
+  still counts exactly one `device_get` per heartbeat segment;
+- drain-contract bit-identity: chained == batched == frontier on the
+  shared histogram families (runlen is frontier-only by design);
+- sharded == single-shard reconciliation: the bundle's device-side
+  host-axis reduction makes the fetched global totals exact;
+- OpenMetrics histogram exposition semantics (monotone `le`, mandatory
+  `+Inf`, `_count`/`_sum` reconciliation) — render and validator;
+- `[stats]` heartbeat rows reconcile exactly with the end-of-run
+  summary through the real CLI;
+- `tools.critical_path` dependency-chain attribution on a known DAG;
+- `tools.diff_runs` drift detection: self-diff is zero, sim drift is
+  always exact, wall-clock keys honor --rtol.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu import examples
+from shadow_tpu.analysis import donation as D
+from shadow_tpu.analysis.hlo_audit import assert_zero_cost
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.timebase import SECOND
+from shadow_tpu.models import phold
+from shadow_tpu.obs.metrics import MetricsRegistry, validate_openmetrics
+from shadow_tpu.obs.stats import (
+    BUCKET_LE,
+    FAMILY_KEYS,
+    NB,
+    StatPlane,
+    bucket_of,
+    parse_hist,
+    percentile,
+    stats_device_refs,
+    stats_row,
+    summarize,
+)
+from shadow_tpu.sim import build_simulation
+
+# ---------------------------------------------------------- bucket math
+
+
+def test_bucket_of_is_bit_length():
+    vals = [0, 1, 2, 3, 4, 7, 8, 1023, 1024, (1 << 62) - 1, 1 << 62,
+            (1 << 62) + 5]
+    idx = bucket_of(jnp.asarray(vals, jnp.int64))
+    expect = [min(int(v).bit_length(), NB - 1) for v in vals]
+    assert idx.tolist() == expect
+    # each finite bucket's upper bound is its le: value le lands in it
+    for i in (1, 5, 62):
+        assert int(bucket_of(jnp.int64(BUCKET_LE[i]))) == i
+        assert int(bucket_of(jnp.int64(BUCKET_LE[i] + 1))) == i + 1
+
+
+def test_observe_summarize_row_roundtrip():
+    sp = StatPlane.create(2)
+    vals = jnp.asarray([[1, 100, 0], [7, 3, 9]], jnp.int64)
+    mask = jnp.asarray([[True, True, False], [True, True, True]])
+    sp = sp.observe("wait", vals, mask)
+    fetched = jax.device_get(stats_device_refs(sp))
+    s = summarize(fetched)
+    assert s["wait"]["count"] == 5
+    assert s["wait"]["sum"] == 1 + 100 + 7 + 3 + 9
+    assert s["net"]["count"] == 0 and s["net"]["p50"] == 0.0
+    # percentile reports the bucket's le upper bound
+    assert s["wait"]["p50"] == float(BUCKET_LE[int(
+        bucket_of(jnp.int64(7)))])
+    # the CSV row's sparse hist cell rebuilds the full bucket vector
+    row = stats_row(2.0, s)
+    cells = row.split(",")
+    assert cells[0] == "2.000"
+    hist_cell = cells[5]  # wait_hist
+    np.testing.assert_array_equal(
+        parse_hist(hist_cell), np.asarray(fetched["wait_bucket"]))
+
+
+def test_percentile_empty_and_overflow():
+    assert percentile(np.zeros(NB, np.int64), 0.5) == 0.0
+    b = np.zeros(NB, np.int64)
+    b[NB - 1] = 3  # all samples in +Inf
+    assert percentile(b, 0.95) == float(1 << 63)
+
+
+# ------------------------------------------------------------ zero cost
+
+
+@pytest.mark.slow
+def test_stats_off_is_zero_cost():
+    """stats=0 leaves no residue: splane is a leaf-free None subtree
+    and the lowered window loop is byte-identical to a stats-naive
+    build, while stats=1 demonstrably changes the program."""
+    eng0, init0 = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+    engz, initz = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
+                              stats=0)
+    engs, inits = phold.build(8, seed=3, capacity=32, msgs_per_host=2,
+                              stats=1)
+    assert_zero_cost((eng0, init0()), (engz, initz()), (engs, inits()),
+                     jnp.int64(SECOND),
+                     get_subtree=lambda st: st.splane)
+
+
+@pytest.mark.slow
+def test_harvest_census_one_fetch_with_stats(monkeypatch):
+    """--stats on rides the existing single-transfer bundle: still
+    exactly one jax.device_get per heartbeat segment, and the fetched
+    bundle carries the global histogram refs."""
+    from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+    sim = D._sim_tiny(stats=1)
+    h = HeartbeatHarvest(sim)
+    state = sim.state0
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get", lambda x: (calls.append(1), real(x))[1])
+    for full in (False, True, False):
+        state, bundle = h.extract(state, full=full)
+        before = len(calls)
+        fetched = h.fetch(bundle)
+        assert len(calls) == before + 1  # the segment's one transfer
+        assert "stats" in fetched
+        assert np.asarray(fetched["stats"]["wait_bucket"]).shape == (NB,)
+    assert len(calls) == 3
+
+
+# ----------------------------------------------- drain-contract identity
+
+
+def _splane_arrays(st):
+    return {f"{k}_{x}": np.asarray(getattr(st.splane, f"{k}_{x}"))
+            for k in FAMILY_KEYS for x in ("n", "s")}
+
+
+@pytest.mark.slow
+def test_phold_batched_and_chained_stats_identical():
+    sts = []
+    for batched in (False, True):
+        eng, init = phold.build(16, seed=3, capacity=64,
+                                msgs_per_host=2, batched=batched,
+                                stats=1)
+        sts.append(jax.device_get(
+            jax.jit(eng.run)(init(), jnp.int64(SECOND))))
+    a, b = (_splane_arrays(st) for st in sts)
+    for key in a:
+        np.testing.assert_array_equal(
+            a[key], b[key], err_msg=f"splane leaf {key} differs "
+            "between chained and batched drains")
+    assert int(a["wait_n"].sum()) > 0  # non-vacuous
+    assert int(a["occ_n"].sum()) > 0
+
+
+@pytest.mark.slow
+def test_tgen_frontier_stats_bit_identity():
+    """Chained vs frontier drain on pure TCP: every shared family is
+    bit-identical; runlen is frontier-only by design (the chained
+    drain has no rounds to measure)."""
+    cfg = parse_config(examples.tgen_example(
+        n_pairs=2, sendsize="8KiB", recvsize="16KiB", count=2,
+        stoptime=10))
+    sts = []
+    for f in (0, 8):
+        sim = build_simulation(cfg, seed=1, frontier=f, n_sockets=4,
+                               stats=1)
+        sim.strict_overflow = False
+        sts.append(jax.device_get(sim.run()))
+    a, b = (_splane_arrays(st) for st in sts)
+    for key in a:
+        if key.startswith("runlen"):
+            continue
+        np.testing.assert_array_equal(
+            a[key], b[key], err_msg=f"splane leaf {key} differs "
+            "between chained and frontier drains")
+    for fam in ("wait", "net", "occ", "qfill"):
+        assert int(a[f"{fam}_n"].sum()) > 0, fam
+    assert int(a["runlen_n"].sum()) == 0  # chained: no rounds
+    assert int(b["runlen_n"].sum()) > 0  # frontier: measured
+
+
+@pytest.mark.slow
+def test_sharded_refs_reconcile_with_single():
+    """The bundle's host-axis reduction runs on device over the global
+    array, so a sharded run fetches exactly the single-device totals
+    (no host-side re-aggregation, no extra collective)."""
+    from shadow_tpu.parallel import mesh as pmesh
+
+    n_shards, per = 4, 8
+    eng1, init1 = phold.build(n_shards * per, seed=3, capacity=32,
+                              msgs_per_host=4, stats=1)
+    st1 = jax.jit(eng1.run)(init1(), jnp.int64(SECOND))
+
+    eng, init = phold.build(per, seed=3, capacity=32, msgs_per_host=4,
+                            axis_name=pmesh.HOSTS_AXIS,
+                            n_shards=n_shards, stats=1)
+    m = pmesh.make_mesh(n_shards)
+    initN, runN, _ = pmesh.build_sharded(eng, init, m, per)
+    stN = runN(initN(), jnp.int64(SECOND))
+
+    ref1 = jax.device_get(stats_device_refs(st1.splane))
+    refN = jax.device_get(stats_device_refs(stN.splane))
+    for key in ref1:
+        np.testing.assert_array_equal(
+            np.asarray(ref1[key]), np.asarray(refN[key]),
+            err_msg=f"stats ref {key} differs sharded vs single")
+    assert int(np.asarray(ref1["wait_bucket"]).sum()) > 0
+
+
+# --------------------------------------------------- OpenMetrics render
+
+
+def _synth_fetched(count=5, val=6):
+    fetched = {}
+    for k in FAMILY_KEYS:
+        b = np.zeros(NB, np.int64)
+        b[int(val).bit_length()] = count
+        fetched[f"{k}_bucket"] = b
+        fetched[f"{k}_sum"] = np.int64(count * val)
+    return fetched
+
+
+def test_histogram_render_validates_and_reconciles():
+    reg = MetricsRegistry(version="t")
+    # stats-off exposition carries no histogram families at all
+    assert "histogram" not in reg.render()
+    reg.ingest_stats(_synth_fetched())
+    text = reg.render()
+    assert validate_openmetrics(text) == []
+    assert "# TYPE shadow_tpu_event_wait_ns histogram" in text
+    assert 'shadow_tpu_event_wait_ns_bucket{le="+Inf"} 5' in text
+    assert "shadow_tpu_event_wait_ns_count 5" in text
+    assert "shadow_tpu_event_wait_ns_sum 30" in text
+    totals = reg.totals()
+    assert totals["shadow_tpu_event_wait_ns_count"] == 5
+    assert totals["shadow_tpu_frontier_run_len_sum"] == 30
+
+
+def test_histogram_validator_catches_breakage():
+    reg = MetricsRegistry(version="t")
+    reg.ingest_stats(_synth_fetched())
+    text = reg.render()
+    # dropping the _count line is a violation
+    broken = "\n".join(
+        ln for ln in text.splitlines()
+        if ln != "shadow_tpu_event_wait_ns_count 5") + "\n"
+    assert any("missing _count" in e for e in validate_openmetrics(broken))
+    # breaking the +Inf terminal bucket is a violation
+    broken = text.replace(
+        'shadow_tpu_event_wait_ns_bucket{le="+Inf"}',
+        'shadow_tpu_event_wait_ns_bucket{le="9"}')
+    errs = validate_openmetrics(broken)
+    assert any("+Inf" in e for e in errs)
+    # a cumulative count that decreases is a violation
+    broken = text.replace(
+        'shadow_tpu_event_wait_ns_bucket{le="+Inf"} 5',
+        'shadow_tpu_event_wait_ns_bucket{le="+Inf"} 1')
+    errs = validate_openmetrics(broken)
+    assert any("decrease" in e or "_count" in e for e in errs)
+
+
+# ------------------------------------------------------------ CLI wiring
+
+
+@pytest.mark.slow
+def test_cli_stats_rows_reconcile_with_summary(capsys):
+    """The end-of-run summary's stats section equals the last
+    cumulative [stats] heartbeat row exactly (same fetched totals)."""
+    from shadow_tpu.cli import main
+    from shadow_tpu.tools.parse_shadow import parse_lines
+
+    rc = main(["--test", "--stoptime", "6", "--heartbeat-frequency",
+               "3", "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[shadow-heartbeat] [stats-header]" in out
+    summary = {}
+    for line in reversed(out.strip().splitlines()):
+        if line.startswith("{"):
+            summary = json.loads(line)
+            break
+    assert set(summary["stats"]) == set(FAMILY_KEYS)
+    parsed = parse_lines(out.splitlines())["stats"]
+    assert parsed["ticks"], "no [stats] rows parsed"
+    for fam in FAMILY_KEYS:
+        assert parsed[f"{fam}_count"][-1] == \
+            summary["stats"][fam]["count"], fam
+        assert parsed[f"{fam}_sum"][-1] == summary["stats"][fam]["sum"]
+    assert summary["stats"]["wait"]["count"] > 0
+
+
+# ---------------------------------------------------------- critical path
+
+
+def _recs(rows):
+    cols = ("time", "op", "src", "dst", "seq", "owner", "kind")
+    return {c: np.asarray([r[i] for r in rows], np.int64)
+            for i, c in enumerate(cols)}
+
+
+def test_critical_path_on_known_dag():
+    """A 2-hop relay chain plus one independent exec: depth equals the
+    chain length, the flow joins resolve through (src, seq, dst), and
+    the width profile counts the off-path exec at depth 1."""
+    from shadow_tpu.obs.trace import OP_EXEC, OP_SEND
+    from shadow_tpu.tools.critical_path import analyze, render
+
+    rows = [
+        # (time, op, src, dst, seq, owner, kind)
+        (100, OP_EXEC, 0, 0, 1, 0, 0),   # root exec on host 0
+        (100, OP_SEND, 0, 1, 5, 0, 0),   # it sends 0->1 seq 5
+        (200, OP_EXEC, 0, 1, 5, 1, 0),   # delivery exec on host 1
+        (200, OP_SEND, 1, 2, 6, 1, 0),   # relays 1->2 seq 6
+        (300, OP_EXEC, 1, 2, 6, 2, 0),   # delivery exec on host 2
+        (150, OP_EXEC, 3, 3, 2, 3, 0),   # independent exec on host 3
+    ]
+    report = analyze(_recs(rows), {"names": ["a", "b", "c", "d"],
+                                   "kind_names": ["k"]})
+    assert report["execs"] == 4
+    assert report["flows"] == 2
+    assert report["depth"] == 3
+    assert report["widths"] == [2, 1, 1]
+    assert report["width_max"] == 2
+    assert report["span_ns"] == 200
+    assert [h for h, _, _ in report["path"]] == ["a", "b", "c"]
+    assert {(e["src"], e["dst"]) for e in report["path_edges"]} == \
+        {("a", "b"), ("b", "c")}
+    text = render(report)
+    assert "critical-path depth: 3 events" in text
+    assert "depth-vs-width profile" in text
+
+
+def test_critical_path_empty_trace():
+    from shadow_tpu.tools.critical_path import analyze
+
+    report = analyze(_recs([]), {})
+    assert report["execs"] == 0 and report["depth"] == 0
+    assert report["path"] == []
+
+
+# -------------------------------------------------------------- diff_runs
+
+
+def test_diff_runs_self_diff_is_zero(tmp_path):
+    from shadow_tpu.tools import diff_runs
+
+    p = tmp_path / "summary.json"
+    p.write_text(json.dumps({"events": 42, "stats": {
+        "wait": {"count": 5, "sum": 30}}, "wall_seconds": 1.23}))
+    assert diff_runs.main([str(p), str(p)]) == 0
+    assert diff_runs.diff_files(str(p), str(p), rtol=0.0) == []
+
+
+def test_diff_runs_sim_drift_is_exact_wall_is_tolerant(tmp_path):
+    from shadow_tpu.tools import diff_runs
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"events": 42, "wall_seconds": 1.00}))
+    # wall-clock drift inside rtol is tolerated; sim drift never is
+    b.write_text(json.dumps({"events": 42, "wall_seconds": 1.04}))
+    assert diff_runs.diff_files(str(a), str(b), rtol=0.05) == []
+    assert diff_runs.main([str(a), str(b)]) == 1  # rtol 0: exact
+    b.write_text(json.dumps({"events": 43, "wall_seconds": 1.00}))
+    entries = diff_runs.diff_files(str(a), str(b), rtol=0.5)
+    assert [e["key"] for e in entries] == ["events"]
+
+
+def test_diff_runs_heartbeat_and_scrape_artifacts(tmp_path):
+    from shadow_tpu.tools import diff_runs
+
+    hb = ("x [shadow-heartbeat] [stats-header] t_s,wait_count\n"
+          "x [shadow-heartbeat] [stats] 3.000,5\n"
+          "x [shadow-heartbeat] [stats] 6.000,9\n")
+    a = tmp_path / "run.log"
+    a.write_text(hb)
+    b = tmp_path / "run2.log"
+    b.write_text(hb.replace("6.000,9", "6.000,11"))
+    entries = diff_runs.diff_files(str(a), str(b), rtol=0.0)
+    assert [e["key"] for e in entries] == ["stats.wait_count"]
+
+    reg = MetricsRegistry(version="t")
+    reg.ingest_stats(_synth_fetched())
+    m1 = tmp_path / "m1.txt"
+    m1.write_text(reg.render())
+    reg.ingest_stats(_synth_fetched(count=7))
+    m2 = tmp_path / "m2.txt"
+    m2.write_text(reg.render())
+    assert diff_runs.diff_files(str(m1), str(m1), rtol=0.0) == []
+    drift = diff_runs.diff_files(str(m1), str(m2), rtol=0.0)
+    assert any("event_wait_ns_count" in e["key"] for e in drift)
+
+
+def test_diff_runs_directories(tmp_path):
+    from shadow_tpu.tools import diff_runs
+
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir(), db.mkdir()
+    (da / "s.json").write_text('{"events": 1}')
+    (db / "s.json").write_text('{"events": 1}')
+    (da / "only_a.json").write_text("{}")
+    rep = diff_runs.diff_dirs(str(da), str(db), rtol=0.0)
+    assert rep["files"]["s.json"] == []
+    assert rep["unmatched_a"] == ["only_a.json"]
