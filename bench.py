@@ -703,6 +703,149 @@ def skew_worker():
         print(json.dumps(out), flush=True)
 
 
+# -- scenario fleets (docs/16-Scenario-Fleets.md) ---------------------
+# The fleet bench is a CPU measurement by contract: what it prices is
+# compile amortization + batched dispatch for seed sweeps, and both are
+# program-structure effects, not silicon effects. The horizon is short
+# on purpose — a sweep's scenarios are typically many and short, which
+# is exactly the regime where N sequential compiles dominate the bill.
+FLEET_LANES = 64
+FLEET_HOSTS = 256
+FLEET_STOP_S = 1
+
+
+def fleet_rate(lanes: int, stop_s: int, *, n_hosts: int = FLEET_HOSTS):
+    """One fleet-vs-sequential measurement, compile included on BOTH
+    sides. The persistent compile cache is pointed at a fresh temp dir
+    first: every solo seed is its own XLA program (the root key is a
+    baked constant), so a warm cache would hand the sequential side the
+    exact amortization the fleet earns by construction and the ratio
+    would be meaningless.
+
+    Sequential = what a seed sweep costs today: per seed, a fresh
+    `phold.build` + `jax.jit(eng.run)` + run. The fleet runs FIRST, so
+    any one-time XLA/LLVM warm-up lands on the fleet's clock — the
+    reported speedup is the conservative one. Every measured lane's
+    final state is compared leaf-for-leaf against its solo run, so the
+    bit-identity acceptance pin rides inside the measurement."""
+    import tempfile
+
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="fleet_bench_cache")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shadow_tpu.core.timebase import SECOND, seconds
+    from shadow_tpu.models import phold
+
+    build_kw = dict(
+        capacity=CAPACITY, latency_ns=seconds(LATENCY_S),
+        mean_delay_ns=seconds(MEAN_DELAY_S), msgs_per_host=MSGS_PER_HOST,
+        batched=True,
+    )
+    seeds = tuple(range(SEED, SEED + lanes))
+    stop = jnp.int64(stop_s * SECOND)
+
+    # fleet: ONE lowered program — build + compile + run on the clock
+    t0 = time.perf_counter()
+    fleet = phold.build_fleet(n_hosts, lanes, seeds=seeds, seed=SEED,
+                              **build_kw)
+    fst = fleet.run(stop)
+    fleet_events = int(jax.device_get(fst.stats.n_executed).sum())
+    fleet_wall = time.perf_counter() - t0
+    flat_f = [np.asarray(x) for x in
+              jax.tree_util.tree_leaves(jax.device_get(fst))]
+
+    # sequential: the same seeds, one full build+jit+compile+run each.
+    # fresh init states alias broadcasted buffers; per-leaf copies make
+    # them donation-safe (same defence as perf_smoke)
+    fresh = lambda init: jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, init()
+    )
+    seq_walls: list[float] = []
+    seq_events = 0
+    identical = True
+    for lane, s in enumerate(seeds):
+        if _remaining() < 45:  # budget guard: extrapolate the tail
+            break
+        t0 = time.perf_counter()
+        seng, sinit = phold.build(n_hosts, seed=s, **build_kw)
+        run = jax.jit(seng.run, donate_argnums=0)
+        sst = run(fresh(sinit), stop)
+        seq_events += int(jax.device_get(sst.stats.n_executed).sum())
+        seq_walls.append(time.perf_counter() - t0)
+        flat_s = jax.tree_util.tree_leaves(jax.device_get(sst))
+        identical = identical and all(
+            bool((a[lane] == np.asarray(b)).all())
+            for a, b in zip(flat_f, flat_s)
+        )
+    measured = len(seq_walls)
+    seq_wall = sum(seq_walls)
+    if 0 < measured < lanes:
+        seq_wall = seq_wall / measured * lanes
+    return {
+        "fleet_lanes": lanes,
+        "fleet_hosts": n_hosts,
+        "fleet_stop_s": stop_s,
+        "fleet_device": str(jax.devices()[0].device_kind),
+        "fleet_wall_s": round(fleet_wall, 3),
+        "fleet_events": fleet_events,
+        "fleet_events_per_s": round(fleet_events / fleet_wall, 1),
+        "fleet_scenarios_per_s": round(lanes / fleet_wall, 3),
+        "fleet_windows": int(jax.device_get(fst.stats.n_windows).max()),
+        "fleet_seq_measured": measured,
+        "fleet_seq_extrapolated": measured < lanes,
+        "fleet_seq_wall_s": round(seq_wall, 3),
+        "fleet_seq_events": seq_events,
+        "fleet_seq_scenarios_per_s": (
+            round(lanes / seq_wall, 3) if seq_wall else 0.0),
+        "fleet_speedup_x": (
+            round(seq_wall / fleet_wall, 2) if fleet_wall else 0.0),
+        "fleet_bit_identical": bool(identical and measured > 0),
+    }
+
+
+def fleet_worker():
+    """`bench.py --fleet`: the 64-lane scenario-fleet headline — one
+    vmapped program vs the same 64 seeds run sequentially, compile
+    included on both sides (BENCH_r08.json acceptance: >= 5x). Override
+    the shape with BENCH_FLEET_LANES / BENCH_FLEET_STOP_S."""
+    lanes = int(os.environ.get("BENCH_FLEET_LANES", FLEET_LANES))
+    stop_s = int(os.environ.get("BENCH_FLEET_STOP_S", FLEET_STOP_S))
+    r = fleet_rate(lanes, stop_s)
+    print(json.dumps(r), flush=True)
+    if r["fleet_speedup_x"] < 5.0:
+        print(f"fleet: x{r['fleet_speedup_x']:.2f} is below the 5x "
+              "acceptance line (compile amortization should dominate "
+              "at this horizon)", file=sys.stderr, flush=True)
+    if not r["fleet_bit_identical"]:
+        print("fleet: per-lane final states DIVERGED from the solo "
+              "runs — the speedup is meaningless", file=sys.stderr)
+        sys.exit(1)
+
+
+def fleet_smoke_worker():
+    """`bench.py --fleet-smoke` (measure_all.sh fleet_smoke stage): an
+    8-lane PHOLD fleet vs the same 8 scenarios sequentially — the
+    lane-equals-solo bit-identity gate (lane 0 included, every measured
+    lane checked) plus the wall-clock ratio on stderr. Exit 1 when
+    identity fails or the sequential side was budget-truncated."""
+    r = fleet_rate(8, FLEET_STOP_S)
+    ok = bool(r["fleet_bit_identical"]) and not r["fleet_seq_extrapolated"]
+    r["fleet_smoke_ok"] = ok
+    print(json.dumps(r), flush=True)
+    print(f"fleet_smoke: {r['fleet_seq_wall_s']:.1f}s sequential vs "
+          f"{r['fleet_wall_s']:.1f}s fleet -> x{r['fleet_speedup_x']:.2f}; "
+          f"lane bit-identity "
+          f"{'pass' if r['fleet_bit_identical'] else 'FAIL'}",
+          file=sys.stderr, flush=True)
+    if not ok:
+        sys.exit(1)
+
+
 def multichip_worker():
     """Weak-scaling PHOLD over an 8-device mesh — MULTICHIP_r*.json
     carries data now, not just a smoke bit.
@@ -1258,6 +1401,26 @@ def perf_smoke():
     tcp_wall = time.perf_counter() - t0
     tcp_rate = tcp_executed / tcp_wall
 
+    # Fleet floor: an 8-lane seed-sweep fleet over the same PHOLD shape
+    # (docs/16-Scenario-Fleets.md). Gates the vmapped window loop's
+    # throughput — a structural regression in the batched program (an
+    # extra scatter, a broken termination mask) lands here as events/s,
+    # without paying the full bench.py --fleet comparison. Warm-cache
+    # like the other two floors: this prices execution, not compile.
+    fleet_lanes = 8
+    fleet = phold.build_fleet(
+        n_hosts, fleet_lanes, seeds=tuple(range(SEED, SEED + fleet_lanes)),
+        capacity=CAPACITY, latency_ns=seconds(LATENCY_S),
+        mean_delay_ns=seconds(MEAN_DELAY_S), msgs_per_host=MSGS_PER_HOST,
+        seed=SEED, batched=True,
+    )
+    jax.block_until_ready(fleet.run(jnp.int64(1 * SECOND)).now)  # compile
+    t0 = time.perf_counter()
+    fst = fleet.run(jnp.int64(stop_s * SECOND))
+    fleet_executed = int(jax.device_get(fst.stats.n_executed).sum())
+    fleet_wall = time.perf_counter() - t0
+    fleet_rate_v = fleet_executed / fleet_wall
+
     floor_path = os.path.join(_REPO, "PERF_FLOOR.json")
     try:
         with open(floor_path) as f:
@@ -1274,14 +1437,18 @@ def perf_smoke():
             "tgen_cpu_events_per_s": round(tcp_rate, 1),
             "tgen_pairs": tcp_pairs, "tgen_stop_s": tcp_stop_s,
             "tgen_frontier": 8,
+            "fleet_cpu_events_per_s": round(fleet_rate_v, 1),
+            "fleet_lanes": fleet_lanes,
         })
         with open(floor_path, "w") as f:
             json.dump(floor, f, indent=2)
             f.write("\n")
     fl = float(floor.get("phold_cpu_events_per_s", 0.0))
     tcp_fl = float(floor.get("tgen_cpu_events_per_s", 0.0))
+    fleet_fl = float(floor.get("fleet_cpu_events_per_s", 0.0))
     ok = fl <= 0 or rate >= 0.7 * fl
     tcp_ok = tcp_fl <= 0 or tcp_rate >= 0.7 * tcp_fl
+    fleet_ok = fleet_fl <= 0 or fleet_rate_v >= 0.7 * fleet_fl
     print(json.dumps({
         "perf_smoke_events_per_s": round(rate, 1),
         "perf_smoke_floor": fl,
@@ -1291,7 +1458,11 @@ def perf_smoke():
         "perf_smoke_tgen_floor": tcp_fl,
         "perf_smoke_tgen_events": tcp_executed,
         "perf_smoke_tgen_wall_s": round(tcp_wall, 3),
-        "perf_smoke_ok": ok and tcp_ok,
+        "perf_smoke_fleet_events_per_s": round(fleet_rate_v, 1),
+        "perf_smoke_fleet_floor": fleet_fl,
+        "perf_smoke_fleet_events": fleet_executed,
+        "perf_smoke_fleet_wall_s": round(fleet_wall, 3),
+        "perf_smoke_ok": ok and tcp_ok and fleet_ok,
     }), flush=True)
     if not ok:
         print(f"perf_smoke: {rate:.0f} events/s is below 70% of the "
@@ -1301,7 +1472,11 @@ def perf_smoke():
         print(f"perf_smoke: tgen {tcp_rate:.0f} events/s is below 70% "
               f"of the PERF_FLOOR.json floor {tcp_fl:.0f} — TCP/frontier "
               f"hot-path regression", file=sys.stderr)
-    if not (ok and tcp_ok):
+    if not fleet_ok:
+        print(f"perf_smoke: fleet {fleet_rate_v:.0f} events/s is below "
+              f"70% of the PERF_FLOOR.json floor {fleet_fl:.0f} — "
+              f"vmapped window-loop regression", file=sys.stderr)
+    if not (ok and tcp_ok and fleet_ok):
         sys.exit(1)
 
 
@@ -1460,6 +1635,8 @@ def main():
                      ("--btc-worker", btc_worker),
                      ("--phold-worker", phold_worker),
                      ("--phold-big-worker", phold_big_worker),
+                     ("--fleet", fleet_worker),
+                     ("--fleet-smoke", fleet_smoke_worker),
                      ("--perf-smoke", perf_smoke),
                      ("--multichip-worker", multichip_worker),
                      ("--chaos-worker", chaos_worker),

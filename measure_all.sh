@@ -146,9 +146,17 @@ print(json.dumps({
 }))
 PYEOF
 echo "=== stats_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
-# perf smoke: a small CPU-backend PHOLD plus a small tgen TCP workload
-# under the frontier drain, each against its checked-in PERF_FLOOR.json
-# floor — fails (exit 1) when either events/s regresses more than 30%.
+# scenario-fleet smoke (docs/16-Scenario-Fleets.md): an 8-lane PHOLD
+# fleet vs the same 8 scenarios run sequentially, compile included on
+# both sides in a fresh cache dir — every measured lane (lane 0
+# included) must be bit-identical to its solo run, and the sequential-
+# vs-fleet wall-clock ratio prints to the stamp log. Exit 1 on an
+# identity failure or a budget-truncated sequential side.
+run fleet_smoke 900 --fleet-smoke JAX_PLATFORMS=cpu BENCH_BUDGET_S=840
+# perf smoke: a small CPU-backend PHOLD, a small tgen TCP workload
+# under the frontier drain, and an 8-lane PHOLD fleet, each against its
+# checked-in PERF_FLOOR.json floor — fails (exit 1) when any of the
+# three events/s numbers regresses more than 30%.
 # Together with the lint + hlo_audit stage below this is the no-TPU
 # regression lane; refresh the floors deliberately with
 # `PERF_SMOKE_UPDATE=1 python bench.py --perf-smoke`.

@@ -201,6 +201,10 @@ def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
       frontier-drain TCP build (docs/11-Performance.md "Model-tier
       batching") — the per-round outbuf staging must not break the
       state carry's aliasing.
+    - fleet_run: the 4-lane PHOLD Fleet's production `_jit_run` (the
+      vmapped window loop, donate_argnums=0 on the stacked `[L, ...]`
+      state) — proves the whole stacked carry aliases through every
+      segment; the lane binds (arg 1) are reused and must NOT donate.
     """
     import jax.numpy as jnp
 
@@ -246,7 +250,17 @@ def audit_all(names: Iterable[str] | None = None) -> dict[str, dict]:
         return audit_jit(jitted, (sim.state0, jnp.int64(sim.stop_ns)),
                          "sharded_step")
 
+    def fleet_run() -> dict:
+        from shadow_tpu.runtime.fleet import build_fleet_from_engine
+
+        eng, st, stop = _phold_tiny()
+        fleet = build_fleet_from_engine(eng, st, 4, seeds=(0, 1, 2, 3))
+        # the production jit itself (donate_argnums=0), not a remake
+        return audit_jit(fleet._jit_run,
+                         (fleet.state0, fleet.binds, stop), "fleet_run")
+
     targets["engine_run"] = engine_run
+    targets["fleet_run"] = fleet_run
     targets["frontier_run"] = frontier_run
     targets["pressure_step"] = pressure_step
     targets["harvest_full"] = lambda: _harvest(True)

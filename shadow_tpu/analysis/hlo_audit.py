@@ -158,6 +158,17 @@ CONTRACTS: dict[str, HloContract] = {
     "tgen_frontier": HloContract("tgen_frontier", _budget(11)),
     "tor_frontier": HloContract("tor_frontier", _budget(7)),
     "bitcoin_frontier": HloContract("bitcoin_frontier", _budget(21)),
+    # The vmapped fleet lowering (ISSUE 15 scenario fleets): the same
+    # window loops batched over a 4-lane seed sweep. Budgets are pinned
+    # EQUAL to the solo contracts — batching a program over scenario
+    # lanes must add no scatter (vmap maps sort->sort, gather->gather,
+    # scatter->scatter with a leading batch dim; the lane binds are
+    # plain traced operands), and the op counts are lane-count-
+    # independent (tests/test_fleet.py compares L=1 vs L=4 histograms).
+    # A fleet budget above its solo twin means lane batching regressed
+    # into per-lane bookkeeping writes.
+    "phold_fleet": HloContract("phold_fleet", _budget(0)),
+    "tgen_fleet": HloContract("tgen_fleet", _budget(11)),
     # The SPMD lowering of the raw PHOLD window loop over an 8-device
     # mesh. Every count is structural (per traced site x per Events
     # leaf), none scale with hosts or events:
@@ -207,6 +218,26 @@ def _build(name: str):
 
         eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
         return eng.run, init(), jnp.int64(5_000_000_000)
+
+    if name == "phold_fleet":
+        from shadow_tpu.models import phold
+        from shadow_tpu.runtime.fleet import build_fleet_from_engine
+
+        eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+        fleet = build_fleet_from_engine(
+            eng, init(), 4, seeds=(0, 1, 2, 3)
+        )
+        return fleet.run_fn(), fleet.state0, jnp.int64(5_000_000_000)
+
+    if name == "tgen_fleet":
+        from shadow_tpu import examples
+        from shadow_tpu.config import parse_config
+        from shadow_tpu.sim import build_fleet, build_simulation
+
+        sim = build_simulation(parse_config(examples.example_config()),
+                               seed=3)
+        fleet = build_fleet(sim, 4, seeds=(0, 1, 2, 3))
+        return fleet.run_fn(), fleet.state0, jnp.int64(sim.stop_ns)
 
     if name == "phold_sharded":
         import jax

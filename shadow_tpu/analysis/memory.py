@@ -27,9 +27,10 @@ review-visible diff instead of a silent 2x on real silicon.
 Budgets cover the five model configs, the frontier-drain twins of the
 three TCP models (`*_frontier` — the per-round outbuf/trace staging is
 the frontier executor's only extra live state, and these entries keep
-its growth review-visible), plus `phold_fleet` — the raw PHOLD engine
-vmapped over a 4-scenario fleet axis — so item-3 scaling regressions
-are caught before the fleet harness exists. Refresh with
+its growth review-visible), plus the fleet twins (`phold_fleet`,
+`tgen_fleet` — the real `runtime.fleet.Fleet` lowering over a 4-lane
+seed sweep, so a per-scenario term that should batch shows up as ~4x
+in review). Refresh with
 ``python -m shadow_tpu.tools.lint --mem-audit --update-baseline``.
 """
 
@@ -45,13 +46,14 @@ from shadow_tpu.analysis.hlo_graph import Func, Module, Op, Region
 BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "MEM_BUDGETS.json")
 
-# The fleet axis the phold_fleet entry vmaps over: small enough to
-# lower fast, big enough that a per-scenario term shows up as 4x.
+# The fleet axis the *_fleet entries vmap over (matches hlo_audit's
+# fleet contracts): small enough to lower fast, big enough that a
+# per-scenario term shows up as 4x.
 FLEET = 4
 
 MEM_CONFIGS = ("phold", "phold_net", "tgen", "tor", "bitcoin",
                "tgen_frontier", "tor_frontier", "bitcoin_frontier",
-               "phold_fleet")
+               "phold_fleet", "tgen_fleet")
 
 
 # ------------------------------------------------------------ liveness
@@ -137,31 +139,17 @@ def estimate_text(text: str) -> dict:
 # ------------------------------------------------------------- configs
 
 
-def _build_fleet():
-    """The raw PHOLD engine vmapped over a FLEET-wide scenario axis —
-    the lowering shape ROADMAP item 3 will run, estimated before it
-    lands."""
-    import jax
-    import jax.numpy as jnp
-
-    from shadow_tpu.models import phold
-
-    eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
-    st = init()
-    fleet_st = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct((FLEET,) + x.shape, x.dtype), st)
-    vrun = jax.vmap(eng.run, in_axes=(0, None))
-    return vrun, fleet_st, jnp.int64(5_000_000_000)
-
-
 def estimate_config(name: str) -> dict:
-    """Lower one config's window loop and estimate its peak."""
+    """Lower one config's window loop and estimate its peak.
+
+    The `*_fleet` entries lower the real `runtime.fleet.Fleet` program
+    (hlo_audit builds them at FLEET lanes): the lane binds are jit
+    closure constants, so the entry args stay exactly the stacked
+    `[FLEET, ...]` state plus the stop scalar — the args-bytes relation
+    tests/test_dataflow.py pins."""
     from shadow_tpu.analysis import hlo_audit
 
-    if name == "phold_fleet":
-        run, state, stop = _build_fleet()
-    else:
-        run, state, stop = hlo_audit._build(name)
+    run, state, stop = hlo_audit._build(name)
     return estimate_text(hlo_audit.lower_text(run, state, stop))
 
 

@@ -44,6 +44,7 @@ synchronizes.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 from typing import Any, Callable, Sequence
 
@@ -784,6 +785,41 @@ class Engine:
             xchg=xchg,
             splane=splane,
         )
+
+    # -- per-lane rebinding (scenario fleets) --------------------------------
+    def bind_lane(self, *, base_key=None, faults=None, fault_reset=None,
+                  network=None):
+        """Shallow-copy this engine with per-lane scenario bindings.
+
+        The fleet tier (runtime/fleet.py) calls this INSIDE a vmapped
+        function, so every value may be a tracer: the RNG root key, the
+        CompiledFaults arrays, and the network wrapper's scale become
+        per-lane traced inputs instead of baked closure constants —
+        the values the engine computes from them are identical either
+        way (rng.root_key(seed) traced vs static yields the same key),
+        which is what makes a fleet lane bit-identical to its solo run.
+        The base engine object is never mutated, so its default (non-
+        fleet) lowering stays byte-identical — the zero-cost pin.
+        """
+        eng = copy.copy(self)
+        if base_key is not None:
+            eng._base_key = base_key
+        if fault_reset is not None:
+            eng.fault_reset = fault_reset
+        if faults is not None:
+            eng.faults = faults
+            eng._f_crash = bool(faults.has_crash)
+            eng._f_link = bool(faults.has_link)
+            eng._f_bw = bool(faults.has_bw)
+            if (eng._f_crash or eng._f_bw) and eng.fault_reset is None:
+                raise ValueError(
+                    "faults with crashes or bandwidth changes need a "
+                    "fault_reset template (the initial hosts pytree)"
+                )
+        if network is not None:
+            eng.network = network
+            eng._use_jitter = bool(getattr(network, "has_jitter", False))
+        return eng
 
     # -- fault-schedule helpers ---------------------------------------------
     def _alive_slice(self, host0):
@@ -2221,10 +2257,13 @@ class Engine:
         def apply(st):
             idx = jnp.arange(tt, dtype=jnp.int32)
             gap = (idx > st.fault_epoch) & (idx <= e)
-            tmpl = jax.tree.map(
-                lambda a: jax.lax.dynamic_slice_in_dim(a, host0, h, axis=0),
-                self.fault_reset,
-            )
+            if self._f_crash or self._f_bw:
+                tmpl = jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, host0, h, axis=0
+                    ),
+                    self.fault_reset,
+                )
             hosts, q, stats = st.hosts, st.queues, st.stats
             if self._f_crash:
                 al_sh = jax.lax.dynamic_slice_in_dim(
@@ -2298,7 +2337,12 @@ class Engine:
             # events may now be below.
             q, xchg = self._xchg_deliver(st.queues, st.xchg, host0)
             st = dataclasses.replace(st, queues=q, xchg=xchg)
-        if self._f_crash or self._f_bw:
+        if self._f_crash or self._f_link or self._f_bw:
+            # link-only schedules advance just the epoch watermark (one
+            # scalar compare per window): keeping the watermark current
+            # for EVERY fault kind is what lets a fleet lane's state
+            # match its solo run leaf-for-leaf whatever mix of fault
+            # kinds its sibling lanes compiled in
             st = self._apply_fault_epoch(st, nxt, host0)
         st = self._drain_window(st, window_end, host0)
         return dataclasses.replace(st, now=window_end)

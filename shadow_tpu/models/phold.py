@@ -204,3 +204,22 @@ def build(
         return eng.init_state(PholdHost.create(n_hosts), init_ev, host0)
 
     return eng, init
+
+
+def build_fleet(n_hosts: int, lanes: int, *, seeds=None, stop_ns: int = 0,
+                **build_kw):
+    """Seed-sweep fleet over one PHOLD shape: `lanes` copies of the
+    `build(n_hosts, **build_kw)` scenario vmapped into one program
+    (docs/16-Scenario-Fleets.md). `seeds` defaults to `seed .. seed+L-1`
+    off the base build's seed; every other knob is uniform across lanes
+    by construction, which is exactly the fleet tier's static-knob rule.
+    """
+    from shadow_tpu.runtime.fleet import build_fleet_from_engine
+
+    eng, init = build(n_hosts, **build_kw)
+    if seeds is None:
+        base = build_kw.get("seed", 0)
+        seeds = tuple(base + i for i in range(lanes))
+    return build_fleet_from_engine(
+        eng, init(), lanes, seeds=tuple(seeds), stop_ns=stop_ns
+    )

@@ -153,6 +153,12 @@ class TGenState:
     `gid` is the host's own global id — static, but carried in the state
     pytree so vmapped handlers can index global config tables for their
     own lane (the engine batches host state; closures aren't sliced).
+
+    The same every-parameter-in-the-state discipline is what lets a
+    whole tgen scenario join a fleet (`sim.build_fleet`, docs/16):
+    under the fleet vmap this state gains a leading lane axis
+    ([L, H, ...]) and the `tgen_fleet` hlo_audit contract pins that the
+    lowered op counts stay lane-count-independent.
     """
 
     gid: jax.Array  # i32 (static iota)

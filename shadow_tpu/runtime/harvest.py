@@ -77,6 +77,34 @@ class HeartbeatHarvest:
 
         sim = self.sim
         tracker, tdrain, pcap = self.tracker, self.tdrain, self.pcap
+        lanes = int(getattr(sim, "lanes", 0) or 0)
+        if lanes:
+            # fleet path: the bundle carries [L]-valued per-lane summary
+            # reductions (computed on device) through the SAME single
+            # fetch. The per-scenario observability consumers are not
+            # lane-aware; the fleet CLI runs without them.
+            if (tracker is not None or tdrain is not None
+                    or pcap is not None or self.metrics):
+                raise ValueError(
+                    "fleet harvest carries per-lane summaries only; "
+                    "tracker/trace/pcap/metrics consumers are "
+                    "per-scenario — attach them to solo runs"
+                )
+            from shadow_tpu.core.timebase import TIME_INVALID
+            from shadow_tpu.runtime.fleet import lane_summary_refs
+
+            def extract_fleet(state):
+                q = state.queues
+                bundle = {
+                    "summary": lane_summary_refs(state),
+                    "fill": jnp.mean(
+                        (q.time != TIME_INVALID).astype(jnp.float32),
+                        axis=tuple(range(1, q.time.ndim)),
+                    ),
+                }
+                return state, bundle
+
+            return jax.jit(extract_fleet, donate_argnums=0)
         has_trace = tdrain is not None and sim.state0.trace is not None
         has_pcap = pcap is not None and sim.state0.hosts.net.cap is not None
         has_ring = sim.state0.queues.spill is not None
@@ -168,9 +196,20 @@ class HeartbeatHarvest:
 
         return jax.device_get(bundle)
 
+    def lane_summaries_from(self, fetched: dict) -> list:
+        """Fleet bundles only: per-lane summary dicts, each
+        bit-identical to the solo run's `state_summary`."""
+        from shadow_tpu.runtime.fleet import lane_summaries_from
+
+        return lane_summaries_from(fetched["summary"])
+
     def summary_from(self, fetched: dict) -> dict:
         """Rebuild `Simulation.summary`'s dict from a fetched bundle
         (no state access, no extra sync)."""
+        if getattr(self.sim, "lanes", 0):
+            from shadow_tpu.runtime.fleet import aggregate_summary
+
+            return aggregate_summary(fetched["summary"])
         out = {k: int(v) for k, v in fetched["summary"].items()}
         sim = self.sim
         if sim.profiler is not None:

@@ -340,6 +340,11 @@ class Simulation:
                 "to accept counted drops"
             )
 
+    def build_fleet(self, lanes: int, **overrides):
+        """Batch `lanes` scenario variants of this simulation into one
+        vmapped Fleet program — see the module-level `build_fleet`."""
+        return build_fleet(self, lanes, **overrides)
+
     def dispatch(self, stop_ns: int, state, window_ns: int | None = None):
         """Asynchronously dispatch the next segment; returns the chained
         state WITHOUT any host<->device sync.
@@ -1366,6 +1371,42 @@ def build_simulation(
         pressure=pressure,
         host_order=applied_order,
     )
+
+
+def build_fleet(sim: Simulation, lanes: int, **overrides):
+    """Batch `lanes` variants of a built scenario into one Fleet.
+
+    Per-lane knobs (`seeds`, `faults`, `latency_scale`,
+    `bandwidth_scale`, `state_override` — see runtime.fleet.FleetPlan)
+    become traced inputs of ONE jitted vmapped window loop; static
+    compile-time knobs (kernel/frontier/window/capacity/...) must stay
+    uniform and are rejected with the reason. The fleet's stacked
+    `[L, ...]` state donates through every segment exactly like the
+    solo `Simulation` jits, and `HeartbeatHarvest` drives it through
+    the same single-fetch path. docs/16-Scenario-Fleets.md has the
+    lane-semantics table.
+    """
+    from shadow_tpu.runtime.fleet import build_fleet_from_engine
+
+    if sim.mesh is not None:
+        raise ValueError(
+            "fleets vmap the single-device engine; a sharded base "
+            "scenario is not supported — shard across fleet replicas "
+            "instead (one fleet per device group)"
+        )
+    if sim.pressure is not None:
+        raise ValueError(
+            "fleets cannot run spill/grow pressure modes; their "
+            "reservoir refills are host-side per-window work that "
+            "cannot ride one fused vmapped program — use --overflow "
+            "drop/strict for fleet runs"
+        )
+    fleet = build_fleet_from_engine(
+        sim.engine, sim.state0, lanes, names=sim.names,
+        stop_ns=sim.stop_ns, **overrides,
+    )
+    fleet.strict_overflow = sim.strict_overflow or sim.overflow == "strict"
+    return fleet
 
 
 def default_registry() -> dict[str, Callable]:

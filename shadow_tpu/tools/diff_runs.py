@@ -41,12 +41,15 @@ def classify(path: str, text: str) -> str:
     """Sniff an artifact's type from its content (extension is a hint
     only: BENCH artifacts are .json, scrapes are often .txt)."""
     stripped = text.lstrip()
+    # heartbeat first: a log can OPEN with a `[shadow-heartbeat]` row
+    # (e.g. a fleet run's header line), which the JSON sniff's leading
+    # "[" would otherwise claim
+    if "[shadow-heartbeat]" in text:
+        return HEARTBEAT_T
     if stripped.startswith("{") or stripped.startswith("["):
         return JSON_T
     if "# EOF" in text or stripped.startswith("# TYPE"):
         return OPENMETRICS_T
-    if "[shadow-heartbeat]" in text:
-        return HEARTBEAT_T
     raise ValueError(f"{path}: unrecognized artifact "
                      "(not JSON / OpenMetrics / heartbeat log)")
 
@@ -68,7 +71,10 @@ def load_openmetrics(text: str) -> dict:
 def load_heartbeat(text: str) -> dict:
     """The LAST row of every `[section]` whose header was also logged:
     cumulative sections ([stats], [metrics]) diff meaningfully on their
-    final row; header columns become the keys."""
+    final row; header columns become the keys. The `[fleet]` section is
+    per-LANE cumulative — its rows key on the lane column, so a fleet
+    log diffs lane by lane (`fleet:3.events`) and a run that lost or
+    gained lanes shows up as only-in-one keys, not a silent overwrite."""
     headers: dict[str, list[str]] = {}
     last: dict[str, list[str]] = {}
     for line in text.splitlines():
@@ -81,11 +87,15 @@ def load_heartbeat(text: str) -> dict:
         section = section.lstrip("[")
         if section.endswith("-header"):
             headers[section[: -len("-header")]] = row.split(",")
+        elif section == "fleet":
+            cells = row.split(",")
+            lane = cells[1] if len(cells) > 1 else "?"
+            last[f"fleet:{lane}"] = cells
         else:
             last[section] = row.split(",")
     out: dict[str, Any] = {}
     for section, row in sorted(last.items()):
-        cols = headers.get(section)
+        cols = headers.get(section.partition(":")[0])
         for i, cell in enumerate(row):
             key = (f"{section}.{cols[i]}" if cols and i < len(cols)
                    else f"{section}[{i}]")

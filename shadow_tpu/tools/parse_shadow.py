@@ -60,6 +60,13 @@ PRESSURE_FIELDS = (
     "spill_lost", "reservoir_resident", "overdue", "harvest_seconds",
 )
 
+# per-LANE [fleet] rows (only with --fleet): one row per lane per
+# heartbeat, keyed by lane index. `seed` is constant per lane, `fill`
+# is the lane's mean queue occupancy in [0, 1]; the rest are the lane's
+# cumulative solo-equivalent summary counters plus the interval delta
+FLEET_FIELDS = ("seed", "now_seconds", "windows", "events",
+                "events_delta", "queue_drops", "fill")
+
 # whole-run [metrics] rows (only with --metrics): the telemetry
 # registry's CUMULATIVE totals — unlike the interval-delta sections
 # above, these columns match a live /metrics scrape and the end-of-run
@@ -104,6 +111,7 @@ def parse_lines(lines) -> dict:
     ram: dict[str, dict] = {}
     faults: dict[str, dict] = {}
     trace: dict[str, dict] = {}
+    fleet: dict[str, dict] = {}
     supervisor: dict[str, list] = {
         "ticks": [], **{f: [] for f in SUPERVISOR_FIELDS}
     }
@@ -195,6 +203,17 @@ def parse_lines(lines) -> dict:
             pressure["harvest_seconds"].append(
                 float(parts[-1]) if parts[-1] else None
             )
+        elif "[shadow-heartbeat] [fleet] " in line:
+            csv = line.rsplit("[shadow-heartbeat] [fleet] ", 1)[1].strip()
+            parts = csv.split(",")
+            if len(parts) != 2 + len(FLEET_FIELDS):
+                continue
+            lane = fleet.setdefault(
+                parts[1], {"ticks": [], **{f: [] for f in FLEET_FIELDS}}
+            )
+            lane["ticks"].append(int(parts[0]))
+            for f, v in zip(FLEET_FIELDS, parts[2:]):
+                lane[f].append(float(v) if f == "fill" else int(v))
         elif "[shadow-heartbeat] [supervisor] " in line:
             csv = line.rsplit(
                 "[shadow-heartbeat] [supervisor] ", 1
@@ -248,14 +267,15 @@ def parse_lines(lines) -> dict:
     # contiguous or tick-ordered
     for series in (supervisor, pressure, metrics, stats):
         _sort_series(series)
-    for per_name in (nodes, ram, faults, trace):
+    for per_name in (nodes, ram, faults, trace, fleet):
         for series in per_name.values():
             _sort_series(series)
     for rows in sockets.values():
         rows.sort(key=lambda r: r["time"])
     return {"nodes": nodes, "sockets": sockets, "ram": ram,
-            "faults": faults, "trace": trace, "supervisor": supervisor,
-            "pressure": pressure, "metrics": metrics, "stats": stats}
+            "faults": faults, "trace": trace, "fleet": fleet,
+            "supervisor": supervisor, "pressure": pressure,
+            "metrics": metrics, "stats": stats}
 
 
 def main(argv=None) -> int:

@@ -123,6 +123,30 @@ def make_figures(stats: dict, outdir: str, fmt: str = "png") -> list[str]:
     ax.set_title("simulation event rate")
     save(fig, "shadow_tpu.events")
 
+    # 4b. fleet lanes — only for --fleet runs (the [fleet] section is
+    # per-lane cumulative, so the event curves are plotted as interval
+    # deltas to match the solo event-rate figure's shape)
+    fleet = stats.get("fleet", {})
+    if fleet:
+        fig, (ax, ax2) = plt.subplots(2, 1, figsize=(8, 6), sharex=True)
+        for lane in sorted(fleet, key=lambda k: int(k)):
+            series = fleet[lane]
+            seed = (series.get("seed") or [None])[0]
+            label = f"lane {lane} (seed {seed})"
+            # fleet counters are cumulative; events_delta is the
+            # tracker-computed interval column
+            ticks, deltas = _series(series, "events_delta")
+            ax.plot(ticks, deltas, label=label)
+            ax2.plot(series.get("ticks", []), series.get("fill", []),
+                     label=label)
+        ax.set_ylabel("events / interval")
+        ax.set_title(f"fleet lanes ({len(fleet)})")
+        if len(fleet) <= 16:
+            ax.legend(fontsize="x-small", ncol=2)
+        ax2.set_xlabel("sim time (s)")
+        ax2.set_ylabel("queue fill")
+        save(fig, "shadow_tpu.fleet")
+
     # 5. fault impact timeline — only when the run had a fault schedule
     # (the [fault] heartbeat section is conditional, so this figure is too)
     faults = stats.get("faults", {})

@@ -73,6 +73,16 @@ PRESSURE_HEADER = (
     "hosts-pressured,fill-hwm,spilled,refilled,spill-lost,"
     "reservoir-resident,overdue,harvest-seconds"
 )
+# scenario-fleet progress (only with --fleet): one row per LANE per
+# heartbeat, from the harvest bundle's [L]-valued summary reductions —
+# per-lane sim clock, window/event totals, the interval's event delta,
+# queue drops, and queue fill. Lanes that finished early keep emitting
+# rows with a frozen clock (their windows are masked no-ops), which is
+# exactly the signal a sweep operator reads lane skew from
+FLEET_HEADER = (
+    "[shadow-heartbeat] [fleet-header] time-seconds,lane,seed,"
+    "now-seconds,windows,events,events-delta,queue-drops,fill"
+)
 
 
 @dataclasses.dataclass

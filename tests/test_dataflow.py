@@ -162,12 +162,17 @@ def test_budgets_checked_in_for_all_configs():
     for name in M.MEM_CONFIGS:
         assert name in budgets, name
         assert budgets[name]["peak_bytes"] > 0
-    # the fleet-vmapped entry scales with the FLEET axis — the item-3
-    # regression net: a per-scenario term must show up as ~FLEET x
-    assert budgets["phold_fleet"]["peak_bytes"] > \
-        2 * budgets["phold"]["peak_bytes"]
-    assert budgets["phold_fleet"]["args_bytes"] == \
-        (budgets["phold"]["args_bytes"] - 8) * M.FLEET + 8
+    # the fleet-vmapped entries scale with the FLEET axis — a
+    # per-scenario term must show up as ~FLEET x. The args relation is
+    # exact: the lane binds are jit closure constants, so a fleet's
+    # entry args are precisely the solo args (minus the shared stop
+    # scalar) stacked FLEET-wide, plus the one stop scalar.
+    for solo, batched in (("phold", "phold_fleet"),
+                          ("tgen", "tgen_fleet")):
+        assert budgets[batched]["peak_bytes"] > \
+            2 * budgets[solo]["peak_bytes"]
+        assert budgets[batched]["args_bytes"] == \
+            (budgets[solo]["args_bytes"] - 8) * M.FLEET + 8
 
 
 def test_phold_estimate_meets_budget_and_missing_budget_fails():

@@ -196,6 +196,8 @@ def test_header_records_mesh_identity(tmp_path):
 # ------------------------------------------------- reshard bit-identity
 
 
+@pytest.mark.slow  # ~17s double-reshard chain; tier-1 keeps the 1->8 reshard
+# bit-identity pin which exercises the same v6 mesh-identity path
 def test_reshard_8_to_4_to_1_bit_identical(tmp_path, straight):
     """A run checkpointed at 8 shards resumes at 4, checkpoints again,
     resumes unsharded, and finishes bit-identical to the uninterrupted

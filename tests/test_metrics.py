@@ -340,6 +340,8 @@ def _run_cli(capsys, argv):
     return rc, out, summary
 
 
+@pytest.mark.slow  # ~14s CLI run; the in-process registry/OpenMetrics
+# reconciliation pins stay in tier-1
 def test_cli_metrics_rows_reconcile_with_summary(capsys):
     rc, out, summary = _run_cli(capsys, [
         "--test", "--stoptime", "8", "--heartbeat-frequency", "4",

@@ -293,6 +293,8 @@ def test_invariants_catch_nan(mid_state):
         validate(bad)
 
 
+@pytest.mark.slow  # ~10s CLI subprocess; the invariant-guard unit pins above
+# cover the checks themselves in-process
 def test_cli_validate_flag_passes_clean_run(tmp_path):
     # end-to-end: --validate on a healthy run must not trip (exercises
     # the every-K-windows cadence inside the real driver loop)

@@ -10,6 +10,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from shadow_tpu.core.engine import ConstantNetwork, Engine, EngineConfig
 from shadow_tpu.core.events import Events
@@ -162,6 +163,8 @@ def test_partial_segment_refill():
     assert int(st.hosts.app.rx[1]) == 2100
 
 
+@pytest.mark.slow  # ~25s retransmission soak; tier-1 keeps the lossless bulk
+# transfer, determinism, and close-path pins for the same stack
 def test_heavy_loss_request_response_recovers():
     """Regression: server-side (passive-open) connections must own an RTO
     timer — with 30% loss the server's reply/FIN retransmits from the

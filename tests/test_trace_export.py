@@ -10,6 +10,8 @@ is structurally valid trace-event JSON with matched flow pairs.
 
 import json
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -188,6 +190,8 @@ def test_sharded_trace_matches_single(tmp_path):
         assert r1[k].tolist() == rN[k].tolist(), k
 
 
+@pytest.mark.slow  # ~12s CLI subprocess end-to-end; the exporter, ring, and
+# sharded==single pins above cover the same plumbing in-process
 def test_cli_trace_profile_end_to_end(tmp_path, capsys):
     """--trace --profile through the real CLI: summary carries trace and
     profile keys, the tracker emits exact [trace] heartbeat rows, and
