@@ -846,6 +846,105 @@ def fleet_smoke_worker():
         sys.exit(1)
 
 
+def serve_smoke_worker():
+    """`bench.py --serve-smoke` (measure_all.sh serve_smoke stage, BENCH_r09
+    acceptance): the resident-service warm-cache headline, in-process.
+
+    A SimService (max_lanes=4) takes two 8-request waves of the
+    serve_client's deterministic mixed stream (two equivalence classes:
+    a plain seed sweep and a crash-fault class with varied stops).
+    Wave 1 is COLD — each class's first launch traces + compiles its
+    fleet program; wave 2 is WARM — same classes, so every launch is a
+    program-cache hit re-invoking the compiled fleet through
+    `make_inputs`. The compile cache is pointed at a fresh temp dir
+    first: a warm persistent cache would hand the cold side the exact
+    amortization the program cache earns and the ratio would be
+    meaningless. Acceptance: warm wave >= 5x faster than cold on CPU.
+
+    Bit-identity rides inside the measurement: one request per class
+    from the WARM wave (the cache-hit path, where a packing bug would
+    hide) is checked against `solo_reference` — exact dict equality."""
+    import tempfile
+
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="serve_bench_cache")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _enable_compile_cache()
+
+    from shadow_tpu.serve.service import SimService, solo_reference
+    from shadow_tpu.tools.serve_client import request_docs
+
+    docs = request_docs(16, mix="mixed", hosts=8, stop_s=0.5)
+    svc = SimService(max_lanes=4, pack_deadline_ms=250,
+                     beat_windows=16).start()
+
+    def wave(wave_docs):
+        t0 = time.perf_counter()
+        rids = [svc.submit(d)["request_id"] for d in wave_docs]
+        pending = set(rids)
+        deadline = time.monotonic() + max(_remaining(), 60)
+        while pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{len(pending)} requests pending")
+            for rid in list(pending):
+                if svc.result(rid)["status"] in ("done", "error"):
+                    pending.discard(rid)
+            time.sleep(0.05)
+        return time.perf_counter() - t0, {r: svc.result(r) for r in rids}
+
+    try:
+        # each 8-request wave fills BOTH classes (4 plain + 4 fault) at
+        # max_lanes=4, so it dispatches as exactly two full launches
+        cold_wall, cold = wave(docs[:8])
+        warm_wall, warm = wave(docs[8:])
+    finally:
+        svc.drain()
+    recs = {**cold, **warm}
+    errors = [r for r in recs.values() if r["status"] != "done"]
+
+    # bit-identity spot check: one warm request per class
+    by_class = {}
+    for d, (rid, r) in zip(docs[8:], sorted(warm.items())):
+        by_class.setdefault(r["class"], (d, r))
+    identical = all(r["summary"] == solo_reference(d)
+                    for d, r in by_class.values())
+
+    t = svc.metrics.totals()
+    snap = svc.cache.snapshot()
+    r = {
+        "serve_requests": len(recs),
+        "serve_errors": len(errors),
+        "serve_classes": len({x["class"] for x in recs.values()}),
+        "serve_max_lanes": 4,
+        "serve_launches": int(t["shadow_tpu_serve_launches"]),
+        "serve_packed_launches": int(
+            t["shadow_tpu_serve_packed_launches"]),
+        "serve_max_lanes_packed": max(
+            (x["lanes_packed"] for x in recs.values()
+             if x["status"] == "done"), default=0),
+        "serve_cache_hits": snap["hits"],
+        "serve_cache_misses": snap["misses"],
+        "serve_cold_wall_s": round(cold_wall, 3),
+        "serve_warm_wall_s": round(warm_wall, 3),
+        "serve_warm_speedup_x": (round(cold_wall / warm_wall, 2)
+                                 if warm_wall else 0.0),
+        "serve_bit_identical": bool(identical),
+    }
+    ok = (not errors and identical
+          and r["serve_warm_speedup_x"] >= 5.0
+          and r["serve_packed_launches"] >= 1)
+    r["serve_smoke_ok"] = ok
+    print(json.dumps(r), flush=True)
+    print(f"serve_smoke: cold {cold_wall:.1f}s vs warm {warm_wall:.1f}s "
+          f"-> x{r['serve_warm_speedup_x']:.2f} "
+          f"(acceptance 5x); bit-identity "
+          f"{'pass' if identical else 'FAIL'}; "
+          f"{r['serve_packed_launches']} packed launches",
+          file=sys.stderr, flush=True)
+    if not ok:
+        sys.exit(1)
+
+
 def multichip_worker():
     """Weak-scaling PHOLD over an 8-device mesh — MULTICHIP_r*.json
     carries data now, not just a smoke bit.
@@ -1637,6 +1736,7 @@ def main():
                      ("--phold-big-worker", phold_big_worker),
                      ("--fleet", fleet_worker),
                      ("--fleet-smoke", fleet_smoke_worker),
+                     ("--serve-smoke", serve_smoke_worker),
                      ("--perf-smoke", perf_smoke),
                      ("--multichip-worker", multichip_worker),
                      ("--chaos-worker", chaos_worker),

@@ -153,6 +153,112 @@ echo "=== stats_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 # vs-fleet wall-clock ratio prints to the stamp log. Exit 1 on an
 # identity failure or a budget-truncated sequential side.
 run fleet_smoke 900 --fleet-smoke JAX_PLATFORMS=cpu BENCH_BUDGET_S=840
+# resident-service smoke (docs/17-Serving.md): a real `shadow_tpu serve`
+# subprocess takes the serve_client's 16-request mixed stream (two
+# equivalence classes). Four gates in one stage: (a) every served
+# summary diffs EXACTLY against its solo_reference via tools/diff_runs
+# (the served-record classify path), (b) >= 1 launch packed >= 2 lanes,
+# (c) the /metrics scrape passes tools/check_openmetrics and carries the
+# serve families, (d) SIGTERM with 2 undispatched requests queued ->
+# graceful drain, exit 0, queue persisted as re-submittable JSON. The
+# warm/cold ratio itself is bench.py --serve-smoke (BENCH_r09.json).
+echo "=== serve_smoke start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"serve_smoke\"}" >> "$R"
+timeout 900 env JAX_PLATFORMS=cpu python - >> "$R" 2>> "$S" <<'PYEOF'
+import json, os, re, shutil, signal, subprocess, sys, time
+
+from shadow_tpu.serve.service import solo_reference
+from shadow_tpu.tools import diff_runs
+from shadow_tpu.tools.serve_client import request_docs, run_load
+
+QF = "measure_serve_queue.json"
+DIR = "measure_served"
+for p in (QF, DIR):
+    (shutil.rmtree if os.path.isdir(p) else
+     lambda q: os.path.exists(q) and os.remove(q))(p)
+
+# a 10-min pack deadline: the 16-request stream dispatches purely via
+# full classes (8 per class / max-lanes 4), and the 2 extra requests
+# submitted afterwards stay QUEUED for the drain-persistence gate
+srv = subprocess.Popen(
+    [sys.executable, "-m", "shadow_tpu", "serve", "--port", "0",
+     "--max-lanes", "4", "--pack-deadline-ms", "600000",
+     "--queue-file", QF],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+port = None
+t0 = time.monotonic()
+for line in srv.stderr:
+    m = re.search(r"listening http://[^:]+:(\d+)/", line)
+    if m:
+        port = int(m.group(1))
+        break
+    if time.monotonic() - t0 > 120:
+        break
+assert port, "server never printed its listening line"
+url = f"http://127.0.0.1:{port}"
+
+docs = request_docs(16, mix="mixed", hosts=8, stop_s=0.5)
+report = run_load(url, docs, out_dir=DIR, timeout_s=600)
+assert report["errors"] == 0, report
+
+# gate (a): every served record diffs exactly against its solo run
+# through tools/diff_runs' served-artifact path (rids are submit order)
+os.makedirs("measure_solo", exist_ok=True)
+drift = []
+for i, doc in enumerate(docs):
+    rid = f"r{i:06d}"
+    solo = os.path.join("measure_solo", f"{rid}.json")
+    with open(solo, "w") as f:
+        json.dump(solo_reference(doc), f, sort_keys=True)
+    entries = diff_runs.diff_files(
+        os.path.join(DIR, f"{rid}.json"), solo, rtol=0.0)
+    drift += [{**e, "rid": rid} for e in entries]
+assert not drift, f"served summaries drifted from solo runs: {drift[:4]}"
+
+# gate (b): >= 1 multi-lane packed launch
+assert report["max_lanes_packed"] >= 2, report
+
+# gate (c): the /metrics scrape is valid OpenMetrics + serve families
+import urllib.request
+scrape = urllib.request.urlopen(f"{url}/metrics", timeout=10).read()
+with open("measure_serve.metrics", "wb") as f:
+    f.write(scrape)
+chk = subprocess.run(
+    [sys.executable, "-m", "shadow_tpu.tools.check_openmetrics",
+     "measure_serve.metrics"], capture_output=True, text=True)
+assert chk.returncode == 0, chk.stdout
+for fam in ("shadow_tpu_serve_requests_total",
+            "shadow_tpu_serve_packed_launches_total",
+            "shadow_tpu_serve_cache_hits_total",
+            "shadow_tpu_serve_request_latency_ns_count"):
+    assert fam.encode() in scrape, f"missing serve family {fam}"
+
+# gate (d): SIGTERM with 2 queued requests -> drain, exit 0, persist
+extra = request_docs(2, mix="mixed", hosts=8, stop_s=0.5, seed0=900)
+for doc in extra:
+    body = json.dumps(doc).encode()
+    urllib.request.urlopen(
+        urllib.request.Request(url + "/submit", data=body), timeout=10)
+srv.send_signal(signal.SIGTERM)
+rc = srv.wait(timeout=120)
+assert rc == 0, f"drain exit code {rc} != 0"
+with open(QF) as f:
+    pending = json.load(f)["pending"]
+assert len(pending) == 2, pending
+assert [p["seed"] for p in pending] == [d["seed"] for d in extra]
+
+print(json.dumps({
+    "serve_bit_identical": True, "serve_diffed": len(docs),
+    "serve_requests_per_sec": report["requests_per_sec"],
+    "serve_p50_ms": report["p50_ms"], "serve_p95_ms": report["p95_ms"],
+    "serve_max_lanes_packed": report["max_lanes_packed"],
+    "serve_launches": report["launches"],
+    "serve_cache_hits_seen": report["cache_hits_seen"],
+    "serve_openmetrics": chk.stderr.strip(),
+    "serve_drain_exit": rc, "serve_queue_persisted": len(pending),
+}))
+PYEOF
+echo "=== serve_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 # perf smoke: a small CPU-backend PHOLD, a small tgen TCP workload
 # under the frontier drain, and an 8-lane PHOLD fleet, each against its
 # checked-in PERF_FLOOR.json floor — fails (exit 1) when any of the

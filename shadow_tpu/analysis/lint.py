@@ -79,6 +79,15 @@ way those disciplines have been (or nearly were) broken:
   quadratically with host count and silently dominates city-scale
   builds. Cross-host lookups are sometimes the point; sanctioned sites
   carry ``# shadowlint: disable=SL112`` with a reason.
+- SL113 blocking socket/HTTP call on the jit or window-dispatch path —
+  ``sock.recv()``/``sock.accept()``/``httpd.serve_forever()``/
+  ``conn.getresponse()`` park the calling thread in the kernel with no
+  deadline. Inside jit scope, or inside a window-loop drive scope
+  (``run``/``step_window``/``dispatch``), that stalls the entire
+  device loop behind one slow peer. The serving plane's discipline
+  (obs/server.py, serve/http.py): blocking socket work lives ONLY on
+  ThreadingHTTPServer handler threads; the drive path never touches a
+  socket.
 
 Findings carry a stable key (rule | relpath | enclosing function |
 stripped source line) so the baseline survives unrelated line drift.
@@ -108,6 +117,7 @@ RULES = {
     "SL110": "wall-clock read inside jit scope",
     "SL111": "donated buffer double-donated or reused after donation",
     "SL112": "computed-index gather of a global host table in handler scope",
+    "SL113": "blocking socket/HTTP call on the jit or window-dispatch path",
 }
 
 # SL112: names under which model handlers receive the global config
@@ -128,6 +138,20 @@ _WALLCLOCK_ATTRS = {
     "time", "perf_counter", "monotonic",
     "time_ns", "perf_counter_ns", "monotonic_ns",
 }
+
+# SL113: blocking socket / http.server entry points — each parks the
+# calling thread in the kernel with NO deadline. Reachable from jit
+# scope or from a window-loop drive scope (`run`/`step_window`/
+# `dispatch`) they stall the whole device loop behind one slow client.
+# The serving discipline (obs/server.py, serve/http.py) keeps them on
+# ThreadingHTTPServer handler threads, never on the drive path.
+_BLOCKING_SOCKET_ATTRS = {
+    "recv", "recvfrom", "recv_into", "recvmsg", "accept",
+    "serve_forever", "handle_request", "getresponse",
+}
+# window-loop drive scopes: the engine/fleet state-threading entry
+# points plus the segment-dispatch site of the run loop
+_DISPATCH_SCOPES = {"run", "step_window", "dispatch"}
 
 # SL107: callables by these names are window-loop entry points (the
 # engine's state-threading convention), and parameters by these names
@@ -506,6 +530,23 @@ class _Linter(ast.NodeVisitor):
                     f"a lost peer hangs here forever; fetch through "
                     f"HeartbeatHarvest / a watchdog-petted site, or mark "
                     f"the line `# shadowlint: no-deadline=<reason>`")
+
+        # SL113: blocking socket/HTTP-server call reachable from jit
+        # scope or a window-loop drive scope — the thread parks in the
+        # kernel with no deadline while the device loop waits behind it
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_SOCKET_ATTRS:
+            drive = [s.name for s in self.scopes
+                     if s.name in _DISPATCH_SCOPES]
+            if in_jit or drive:
+                where = ("jit scope" if in_jit
+                         else f"window-dispatch scope `{drive[-1]}`")
+                self._emit(
+                    "SL113", node,
+                    f"`{_unparse(node.func)}()` blocks in the kernel "
+                    f"with no deadline inside {where}; socket/HTTP work "
+                    f"belongs on a handler thread "
+                    f"(obs.server/serve.http discipline)")
 
         # SL108: collectives lowered into a loop/branch predicate
         self._check_pred_collective(node, base)

@@ -148,6 +148,31 @@ def make_parser() -> argparse.ArgumentParser:
                         "Per-lane heartbeat progress prints as [fleet] "
                         "rows; the summary JSON grows a per-lane "
                         "'lanes' list")
+    p.add_argument("--port", type=int, default=0, metavar="PORT",
+                   help="serve mode: HTTP port for the request plane "
+                        "(0 = kernel-assigned ephemeral port, printed "
+                        "to stderr). Only with the 'serve' subcommand "
+                        "(docs/17-Serving.md)")
+    p.add_argument("--max-lanes", type=int, default=8, metavar="L",
+                   help="serve mode: fleet lanes per launch — every "
+                        "cached program compiles at exactly L lanes; "
+                        "short batches pad with inert lanes")
+    p.add_argument("--pack-deadline-ms", type=float, default=50.0,
+                   metavar="MS",
+                   help="serve mode: max time a queued request waits "
+                        "for lane-mates before its class launches "
+                        "partially packed (deadline-or-full dispatch)")
+    p.add_argument("--max-cached-programs", type=int, default=4,
+                   metavar="N",
+                   help="serve mode: compiled fleet programs kept warm; "
+                        "LRU eviction past N (docs/17-Serving.md)")
+    p.add_argument("--queue-file", default="shadow_tpu.queue.json",
+                   help="serve mode: pending requests persist here on "
+                        "graceful SIGTERM drain and reload on the next "
+                        "start")
+    p.add_argument("--beat-windows", type=int, default=32, metavar="N",
+                   help="serve mode: simulation windows per progress "
+                        "heartbeat (one single-fetch harvest per beat)")
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
                    help="write a checkpoint every N sim seconds (0=off). "
                         "Independent of the interval, SIGINT/SIGTERM "
@@ -509,6 +534,43 @@ def _run_fleet(args, cfg, sim, t0: float) -> int:
     return 0
 
 
+def _run_serve(args) -> int:
+    """`shadow_tpu serve`: the resident scenario service
+    (docs/17-Serving.md). The main thread owns the signal plane; the
+    launch worker and the HTTP handler threads do the work. SIGTERM /
+    SIGINT trigger the graceful drain — finish the launch in flight,
+    persist the pending queue to --queue-file, exit 0."""
+    from shadow_tpu.runtime.supervisor import Supervisor
+    from shadow_tpu.serve.http import ServeServer
+    from shadow_tpu.serve.service import SimService
+
+    svc = SimService(
+        max_lanes=args.max_lanes,
+        pack_deadline_ms=args.pack_deadline_ms,
+        max_cached_programs=args.max_cached_programs,
+        beat_windows=args.beat_windows,
+        queue_file=args.queue_file,
+    )
+    with Supervisor(label="shadow_tpu-serve") as sup:
+        restored = svc.load_queue()
+        if restored:
+            print(f"serve: restored {restored} pending request(s) from "
+                  f"{args.queue_file}", file=sys.stderr, flush=True)
+        svc.start()
+        srv = ServeServer(svc, port=args.port).start()
+        try:
+            while not sup.stop_requested:
+                time.sleep(0.2)
+        finally:
+            srv.close()
+            report = svc.drain()
+            print(f"serve: drained — {report['persisted']} pending "
+                  f"request(s) persisted to {report['queue_file']}",
+                  file=sys.stderr, flush=True)
+            sup.mark_drained()
+    return sup.exit_code()
+
+
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
     if args.show_build_info:
@@ -537,6 +599,10 @@ def main(argv=None) -> int:
         print("note: --workers/--scheduler-policy are pthread-era flags; "
               "parallelism is the device mesh here", file=sys.stderr)
 
+    if args.config == "serve":
+        # resident scenario service — no config file; scenarios arrive
+        # as requests over the HTTP plane (docs/17-Serving.md)
+        return _run_serve(args)
     if args.test:
         cfg = parse_config(example_config())
     elif args.config:

@@ -434,6 +434,134 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+# ---------------------------------------------------------------- serving
+#
+# The resident scenario-serving plane (shadow_tpu.serve) exposes its own
+# small family catalog through the same exposition machinery. It is a
+# SEPARATE registry on purpose: a batch run's /metrics must stay
+# byte-stable against serve-plane churn (the --metrics zero-cost pin),
+# and a serving process has no harvest summary to ingest — every value
+# here is host-side scheduler state. The request-latency histogram rides
+# the obs.stats log2-bucket scheme (NB buckets, le = 2^i - 1) so the
+# same parse/plot tooling reads both planes.
+
+SERVE_SPECS: tuple[MetricSpec, ...] = (
+    MetricSpec(_P + "serve_requests", "counter",
+               "Scenario requests accepted by the serving plane.",
+               "host-side: SimService.submit"),
+    MetricSpec(_P + "serve_results", "counter",
+               "Requests completed with a summary.",
+               "host-side: launch completion"),
+    MetricSpec(_P + "serve_errors", "counter",
+               "Requests failed (build/launch errors).",
+               "host-side: launch failure path"),
+    MetricSpec(_P + "serve_cache_hits", "counter",
+               "Program-cache hits (warm compiled fleet reused).",
+               "host-side: serve.cache.ProgramCache"),
+    MetricSpec(_P + "serve_cache_misses", "counter",
+               "Program-cache misses (fresh compile).",
+               "host-side: serve.cache.ProgramCache"),
+    MetricSpec(_P + "serve_cache_evictions", "counter",
+               "Programs evicted LRU at --max-cached-programs.",
+               "host-side: serve.cache.ProgramCache"),
+    MetricSpec(_P + "serve_launches", "counter",
+               "Fleet launches dispatched.",
+               "host-side: packer launch loop"),
+    MetricSpec(_P + "serve_packed_launches", "counter",
+               "Launches that packed >= 2 requests into one fleet.",
+               "host-side: packer launch loop"),
+    MetricSpec(_P + "serve_lanes", "counter",
+               "Fleet lanes occupied by live requests, cumulative.",
+               "host-side: packer launch loop"),
+    MetricSpec(_P + "serve_queue_depth", "gauge",
+               "Requests queued awaiting lane packing.",
+               "host-side: LanePacker depth"),
+    MetricSpec(_P + "serve_inflight", "gauge",
+               "Requests riding the launch currently on device.",
+               "host-side: packer launch loop"),
+    MetricSpec(_P + "serve_cached_programs", "gauge",
+               "Compiled fleet programs resident in the cache.",
+               "host-side: serve.cache.ProgramCache"),
+    MetricSpec(_P + "serve_last_lanes_packed", "gauge",
+               "Live lanes in the most recent launch.",
+               "host-side: packer launch loop"),
+)
+
+_SERVE_HIST = _P + "serve_request_latency_ns"
+
+
+class ServeMetrics:
+    """Thread-safe serve-plane registry: the SERVE_SPECS counters and
+    gauges plus one submit->result latency histogram on the obs.stats
+    log2-bucket scheme. `render()` is deterministic (family catalog
+    order, no scrape-varying state) and passes `validate_openmetrics`
+    — the serve_smoke gate scrapes it through tools/check_openmetrics.
+    """
+
+    def __init__(self):
+        import threading
+
+        from shadow_tpu.obs.stats import NB
+
+        self._lock = threading.Lock()
+        self._v: dict[str, float] = {s.name: 0 for s in SERVE_SPECS}
+        self._lat_buckets = [0] * NB
+        self._lat_sum = 0
+
+    def inc(self, family: str, n: float = 1) -> None:
+        with self._lock:
+            self._v[_P + family] += n
+
+    def set(self, family: str, v: float) -> None:
+        with self._lock:
+            self._v[_P + family] = v
+
+    def observe_latency_ns(self, ns: int) -> None:
+        """Fold one request's submit->result wall latency into the
+        histogram. Bucket index = bit_length(ns) clipped, the exact
+        host-side mirror of obs.stats.bucket_of."""
+        from shadow_tpu.obs.stats import NB
+
+        ns = int(ns)
+        idx = 0 if ns <= 0 else min(ns.bit_length(), NB - 1)
+        with self._lock:
+            self._lat_buckets[idx] += 1
+            self._lat_sum += max(ns, 0)
+
+    def totals(self) -> dict:
+        with self._lock:
+            out = {k: (int(v) if float(v).is_integer() else v)
+                   for k, v in sorted(self._v.items())}
+            out[f"{_SERVE_HIST}_count"] = sum(self._lat_buckets)
+            out[f"{_SERVE_HIST}_sum"] = self._lat_sum
+        return out
+
+    def render(self) -> str:
+        from shadow_tpu.obs.stats import BUCKET_LE_LABELS
+
+        with self._lock:
+            values = dict(self._v)
+            buckets = list(self._lat_buckets)
+            lat_sum = self._lat_sum
+        lines: list[str] = []
+        for spec in SERVE_SPECS:
+            lines.append(f"# TYPE {spec.name} {spec.kind}")
+            lines.append(f"# HELP {spec.name} {spec.help}")
+            suffix = "_total" if spec.kind == "counter" else ""
+            lines.append(f"{spec.name}{suffix} {_fmt(values[spec.name])}")
+        lines.append(f"# TYPE {_SERVE_HIST} histogram")
+        lines.append(f"# HELP {_SERVE_HIST} Submit->result request "
+                     "latency, wall nanoseconds.")
+        cum = 0
+        for le, n in zip(BUCKET_LE_LABELS, buckets):
+            cum += n
+            lines.append(f'{_SERVE_HIST}_bucket{{le="{le}"}} {cum}')
+        lines.append(f"{_SERVE_HIST}_sum {lat_sum}")
+        lines.append(f"{_SERVE_HIST}_count {cum}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
 def validate_openmetrics(text: str) -> list[str]:
     """Minimal OpenMetrics syntax checker (the metrics_smoke gate).
     Returns a list of violations; empty means the exposition is

@@ -25,12 +25,22 @@ import sys
 import threading
 
 
-class _Handler(http.server.BaseHTTPRequestHandler):
-    server_version = "shadow-tpu-metrics/1"
+OPENMETRICS_CT = ("application/openmetrics-text; version=1.0.0; "
+                  "charset=utf-8")
+
+
+class BaseHandler(http.server.BaseHTTPRequestHandler):
+    """Shared handler discipline for every shadow_tpu HTTP plane (this
+    metrics exporter and serve.http's request plane): HTTP/1.1 with
+    explicit Content-Length (keep-alive safe), silent access logs, and
+    the one `_send` helper. Blocking socket work stays on the handler
+    threads spawned by ThreadingHTTPServer — never on the window-loop
+    dispatch thread (shadowlint SL113)."""
+
+    server_version = "shadow-tpu/1"
     protocol_version = "HTTP/1.1"
 
-    OPENMETRICS_CT = ("application/openmetrics-text; version=1.0.0; "
-                      "charset=utf-8")
+    OPENMETRICS_CT = OPENMETRICS_CT
 
     def log_message(self, format, *args):  # noqa: A002 - stdlib signature
         pass  # scrapes must not spam the run's stderr
@@ -41,6 +51,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+
+class _Handler(BaseHandler):
+    server_version = "shadow-tpu-metrics/1"
 
     def do_GET(self):  # noqa: N802 - stdlib signature
         srv: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]

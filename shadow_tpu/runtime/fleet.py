@@ -163,16 +163,25 @@ def scaled_network(base, scale: float) -> ScaledLatencyNetwork:
     return ScaledLatencyNetwork(base, jnp.int64(int(round(scale * LAT_UNIT))))
 
 
-def _pad_faults(comp: list[CompiledFaults]):
+def _pad_faults(comp: list[CompiledFaults], tmax: int | None = None,
+                gmax: int | None = None):
     """Pad per-lane CompiledFaults to one uniform shape and stack.
 
     Values-neutral by construction: epoch-time pads are `_T_INF` (never
     reached, so `epoch_of` is unchanged for real times), alive pads are
     True, latency pads are LAT_UNIT (1.0x), pass pads are 1.0, and
     bandwidth pads are 1.0. Returns ({bind arrays [L, ...]}, flags).
+
+    `tmax`/`gmax` optionally force MINIMUM epoch/group padding targets:
+    the serving plane pins them per program-cache class so every batch
+    in the class binds identically-shaped fault arrays and reuses one
+    compiled fleet program (shapes are static; a schedule exceeding the
+    target simply widens it, which is a different equivalence class).
     """
-    tmax = max(f.np_times.shape[0] for f in comp)
-    gmax = max(int(f.lat_milli.shape[1]) for f in comp)
+    t_need = max(f.np_times.shape[0] for f in comp)
+    g_need = max(int(f.lat_milli.shape[1]) for f in comp)
+    tmax = t_need if tmax is None else max(int(tmax), t_need)
+    gmax = g_need if gmax is None else max(int(gmax), g_need)
     hg = int(comp[0].alive.shape[1])
     times, alive, fgrp, lat, passp, bw = [], [], [], [], [], []
     for f in comp:
@@ -279,7 +288,8 @@ class Fleet:
     profiler = None
 
     def __init__(self, engine, state0, plan: FleetPlan, *, names=None,
-                 stop_ns: int = 0, strict_overflow: bool = True):
+                 stop_ns: int = 0, strict_overflow: bool = True,
+                 per_lane_stop: bool = False, fault_pad=None):
         if engine.cfg.axis_name is not None:
             raise ValueError(
                 "fleets vmap the single-device engine; a sharded base "
@@ -292,12 +302,50 @@ class Fleet:
         self.names = list(names) if names is not None else None
         self.strict_overflow = strict_overflow
         self.overflow = "drop"
+        # per_lane_stop: the stop time becomes a traced [L] input (one
+        # lane axis more on the vmap), so every lane truncates its LAST
+        # window at its OWN stop — exactly like its solo run. This is
+        # what lets the serving plane pack requests with mixed stop
+        # times into one launch and still return summaries bit-identical
+        # to solo `Simulation.run` (a shared scalar stop would truncate
+        # early lanes' windows at the fleet-wide stop instead).
+        self.per_lane_stop = bool(per_lane_stop)
+        # fault_pad: (tmax, gmax) minimum fault-array padding targets,
+        # pinned per serving equivalence class (see `_pad_faults`)
+        self._fault_pad = fault_pad
+        self._base_state0 = state0
+
+        self.seeds, self.state0, binds, self._fault_flags = \
+            self._plan_inputs(plan)
+        self.binds = binds
+
+        lane_run, lane_step = self._make_lane_fns()
+        # in_axes: state and binds carry the lane axis; stop (and the
+        # traced window bound) are shared scalars — unless per_lane_stop
+        # gives the stop its own lane axis
+        s_ax = 0 if self.per_lane_stop else None
+        self._batched_run = jax.vmap(lane_run, in_axes=(0, 0, s_ax))
+        self._batched_step_w = jax.vmap(
+            lane_step, in_axes=(0, 0, s_ax, None)
+        )
+        # donation mirrors Simulation._wrap: the [L, ...] state is the
+        # only donated argument — binds are reused across every segment
+        self._jit_run = jax.jit(self._batched_run, donate_argnums=0)
+        self._jit_step_w = None
+        self._owned = None
+
+    def _plan_inputs(self, plan: FleetPlan):
+        """Lower a FleetPlan to its traced launch inputs: per-lane
+        seeds, the stacked `[L, ...]` initial state, the bind dict, and
+        the static fault flags. Host-side numpy work only — nothing
+        here compiles."""
+        engine, state0 = self.engine, self._base_state0
         lanes = plan.lanes
 
         seeds = plan.seeds
         if seeds is None:
             seeds = tuple(engine.cfg.seed for _ in range(lanes))
-        self.seeds = tuple(int(s) for s in seeds)
+        seeds = tuple(int(s) for s in seeds)
 
         # ---- per-lane initial states (host-side, once) ----------------
         lane_states = []
@@ -308,15 +356,13 @@ class Fleet:
             if plan.bandwidth_scale is not None:
                 st = _scale_nic(st, plan.bandwidth_scale[i])
             lane_states.append(st)
-        self.state0 = jax.tree.map(
-            lambda *xs: jnp.stack(xs), *lane_states
-        )
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *lane_states)
 
         # ---- lane binds: the traced per-lane scenario knobs ------------
         binds: dict[str, Any] = {
-            "key": jnp.stack([srng.root_key(s) for s in self.seeds]),
+            "key": jnp.stack([srng.root_key(s) for s in seeds]),
         }
-        self._fault_flags = None
+        fault_flags = None
         if plan.faults is not None and any(plan.faults):
             if engine.faults is not None:
                 raise ValueError(
@@ -328,13 +374,14 @@ class Fleet:
             hg = engine.cfg.n_hosts * engine.cfg.n_shards
             nm = self.names or [f"host{i}" for i in range(hg)]
             comp = [
-                compile_faults(tuple(sp or ()), nm, hg, self.seeds[i])
+                compile_faults(tuple(sp or ()), nm, hg, seeds[i])
                 for i, sp in enumerate(plan.faults)
             ]
-            fb, flags = _pad_faults(comp)
+            pad = self._fault_pad or (None, None)
+            fb, flags = _pad_faults(comp, pad[0], pad[1])
             if any(flags):
                 binds.update(fb)
-                self._fault_flags = flags
+                fault_flags = flags
                 if flags[0] or flags[2]:
                     # crash/bw epochs re-template host rows: bind each
                     # lane's own initial hosts as its reset template
@@ -347,20 +394,43 @@ class Fleet:
                 [int(round(s * LAT_UNIT)) for s in plan.latency_scale],
                 jnp.int64,
             )
-        self.binds = binds
+        return seeds, stacked, binds, fault_flags
 
-        lane_run, lane_step = self._make_lane_fns()
-        # in_axes: state and binds carry the lane axis; stop (and the
-        # traced window bound) are shared scalars
-        self._batched_run = jax.vmap(lane_run, in_axes=(0, 0, None))
-        self._batched_step_w = jax.vmap(
-            lane_step, in_axes=(0, 0, None, None)
-        )
-        # donation mirrors Simulation._wrap: the [L, ...] state is the
-        # only donated argument — binds are reused across every segment
-        self._jit_run = jax.jit(self._batched_run, donate_argnums=0)
-        self._jit_step_w = None
-        self._owned = None
+    def make_inputs(self, plan: FleetPlan):
+        """Launch inputs `(state0, binds)` for a NEW plan through the
+        SAME compiled program — the warm-path entry the serving plane's
+        program cache re-invokes per packed batch.
+
+        The plan must be structurally compatible with the template this
+        Fleet compiled: same lane count, same fault flags, and a bind
+        pytree of identical structure/shapes (the fault pad targets
+        pinned at build time make schedules of differing length land on
+        one shape). Violations raise instead of silently retracing.
+        The returned state is registered donation-safe (`_note_owned`),
+        so `run`/`dispatch` consume it without a defensive copy.
+        """
+        if plan.lanes != self.lanes:
+            raise ValueError(
+                f"plan has {plan.lanes} lanes; this fleet compiled "
+                f"{self.lanes} — pad short batches with inert lanes "
+                "(inert_lane_state) instead of rebuilding"
+            )
+        _, state0, binds, flags = self._plan_inputs(plan)
+        if flags != self._fault_flags:
+            raise ValueError(
+                f"fault flags {flags} do not match the compiled "
+                f"template's {self._fault_flags}; fault-kind mix is a "
+                "static knob of the lowered program — route this batch "
+                "to its own equivalence class"
+            )
+        if (jax.tree.structure(binds) != jax.tree.structure(self.binds)
+                or [x.shape for x in jax.tree.leaves(binds)]
+                != [x.shape for x in jax.tree.leaves(self.binds)]):
+            raise ValueError(
+                "bind structure/shape mismatch vs the compiled "
+                "template; the batch needs its own equivalence class"
+            )
+        return self._note_owned(state0), binds
 
     # -- lane binding -----------------------------------------------------
 
@@ -413,13 +483,34 @@ class Fleet:
         census inspect."""
         return lambda st, stop: self._batched_run(st, self.binds, stop)
 
-    def run(self, stop_ns: int | None = None, state=None):
+    def _stop_arg(self, stop_ns):
+        """The traced stop input: a scalar, or — per_lane_stop — an
+        `[L]` vector (a scalar broadcasts to every lane)."""
+        if self.per_lane_stop:
+            arr = jnp.asarray(stop_ns, jnp.int64)
+            if arr.ndim == 0:
+                arr = jnp.full((self.lanes,), arr, jnp.int64)
+            if arr.shape != (self.lanes,):
+                raise ValueError(
+                    f"per-lane stop must be scalar or [{self.lanes}], "
+                    f"got shape {arr.shape}"
+                )
+            return arr
+        return jnp.int64(stop_ns)
+
+    def run(self, stop_ns: int | None = None, state=None, *, binds=None):
         """Jit-run every lane to the stop time (finished lanes mask to
         no-ops); returns the stacked final state. The state input is
-        donated — `state0` is defended by copy, like Simulation.run."""
+        donated — `state0` is defended by copy, like Simulation.run.
+        `binds` optionally swaps in a fresh batch's lane knobs from
+        `make_inputs` (the serving warm path); None uses the plan this
+        fleet was built with."""
         st = self._fresh_state(state)
-        stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
-        out = self._note_owned(self._jit_run(st, self.binds, stop))
+        stop = self._stop_arg(
+            stop_ns if stop_ns is not None else self.stop_ns
+        )
+        b = self.binds if binds is None else binds
+        out = self._note_owned(self._jit_run(st, b, stop))
         if self.strict_overflow:
             drops = int(jax.device_get(_lane_sum(out.queues.drops).sum()))  # shadowlint: no-deadline=library run() path; the fleet CLI uses HeartbeatHarvest
             if drops > 0:
@@ -427,44 +518,49 @@ class Fleet:
                     jax.device_get(lane_summary_refs(out))))  # shadowlint: no-deadline=overflow error path
         return out
 
-    def dispatch(self, stop_ns: int, state, window_ns: int | None = None):
+    def dispatch(self, stop_ns, state, window_ns: int | None = None,
+                 *, binds=None):
         """Asynchronously dispatch the next fleet segment — the depth-1
         dispatch-ahead half of the CLI loop, no host<->device sync."""
         st = self._fresh_state(state)
-        stop = jnp.int64(stop_ns)
+        stop = self._stop_arg(stop_ns)
+        b = self.binds if binds is None else binds
         if window_ns is None:
-            return self._note_owned(self._jit_run(st, self.binds, stop))
+            return self._note_owned(self._jit_run(st, b, stop))
         if self._jit_step_w is None:
             self._jit_step_w = jax.jit(
                 self._batched_step_w, donate_argnums=0
             )
         return self._note_owned(
-            self._jit_step_w(st, self.binds, stop, jnp.int64(window_ns))
+            self._jit_step_w(st, b, stop, jnp.int64(window_ns))
         )
 
-    def step_window(self, state, stop_ns: int | None = None,
-                    window_ns: int | None = None):
+    def step_window(self, state, stop_ns=None,
+                    window_ns: int | None = None, *, binds=None):
         """Advance every live lane one conservative window."""
         if window_ns is not None:
             return self.dispatch(
                 stop_ns if stop_ns is not None else self.stop_ns,
-                state, window_ns,
+                state, window_ns, binds=binds,
             )
         st = self._fresh_state(state)
-        stop = jnp.int64(stop_ns if stop_ns is not None else self.stop_ns)
+        stop = self._stop_arg(
+            stop_ns if stop_ns is not None else self.stop_ns
+        )
+        b = self.binds if binds is None else binds
         # fixed-window step: the lane step with the static default bound
         # (None keeps bit-identical results, like Simulation.step_window)
         if getattr(self, "_jit_step_fixed", None) is None:
             _, lane_step = self._make_lane_fns()
             self._jit_step_fixed = jax.jit(
                 jax.vmap(
-                    lambda s, b, t: lane_step(s, b, t, None),
-                    in_axes=(0, 0, None),
+                    lambda s, bi, t: lane_step(s, bi, t, None),
+                    in_axes=(0, 0, 0 if self.per_lane_stop else None),
                 ),
                 donate_argnums=0,
             )
         return self._note_owned(
-            self._jit_step_fixed(st, self.binds, stop)
+            self._jit_step_fixed(st, b, stop)
         )
 
     # -- summaries --------------------------------------------------------
@@ -513,6 +609,28 @@ class Fleet:
             self._owned = weakref.WeakValueDictionary()
         self._owned[id(state)] = state
         return state
+
+
+def inert_lane_state(state):
+    """A zero-event lane state: every queue slot emptied (time ==
+    TIME_INVALID), everything else untouched.
+
+    The window loop's predicate is `next_event < stop`, so an inert
+    lane executes ZERO windows — its stats counters, drop counts, and
+    queues stay exactly as initialized (all zero) and only `now` lands
+    on the lane's stop. This is how the serving packer launches a
+    partial batch (R live requests) through a program compiled at
+    `max_lanes`: the L - R pad lanes ride along as provable no-ops
+    instead of forcing a recompile per batch size (tests/test_serve.py
+    pins the counters at exactly zero).
+    """
+    from shadow_tpu.core.timebase import TIME_INVALID
+
+    q = state.queues
+    q = dataclasses.replace(
+        q, time=jnp.full_like(q.time, TIME_INVALID)
+    )
+    return dataclasses.replace(state, queues=q)
 
 
 def _scale_nic(state, scale: float):

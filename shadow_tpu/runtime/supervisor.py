@@ -282,6 +282,7 @@ class Supervisor:
         )
         self.label = label
         self.stop_signum: int | None = None
+        self._drained = False
         self._ckpt_requested = False
         self._install_signals = install_signals
         self._saved: dict[int, Any] = {}
@@ -336,9 +337,23 @@ class Supervisor:
     def stop_requested(self) -> bool:
         return self.stop_signum is not None
 
+    def mark_drained(self) -> None:
+        """Record that the stop signal was honored with a COMPLETE
+        graceful drain (in-flight work finished, queue persisted) —
+        `exit_code()` then reports success (0) instead of 128+signum.
+        Batch runs keep the shell convention: an interrupted run is
+        interrupted, even when it checkpointed cleanly. A resident
+        service is different — SIGTERM is its NORMAL shutdown path
+        (a rolling restart, a scale-down), so a completed drain is a
+        success its orchestrator must not retry."""
+        self._drained = True
+
     def exit_code(self) -> int:
-        """128+signum once a stop was requested (0 otherwise)."""
-        return signal_exit_code(self.stop_signum) if self.stop_requested else 0
+        """128+signum once a stop was requested (0 otherwise; also 0
+        after `mark_drained` — a completed graceful drain)."""
+        if not self.stop_requested or self._drained:
+            return 0
+        return signal_exit_code(self.stop_signum)
 
     def take_checkpoint_request(self) -> bool:
         """Drain the one-shot SIGUSR1 checkpoint request."""

@@ -1,0 +1,46 @@
+"""Resident scenario serving: multi-tenant requests on one warm engine.
+
+The batch tiers compile a fresh XLA program per process launch; the
+fleet tier (docs/16-Scenario-Fleets.md) showed 64 scenarios sharing one
+lowered program amortize that compile 8x. This package turns the
+amortization into an architecture: a long-lived service that
+
+- accepts scenario requests over a stdlib-only HTTP plane
+  (`serve.http`: POST /submit, GET /result/<id>, /queue, /metrics),
+- keys compiled fleet programs by their static-knob equivalence class
+  and keeps them warm across requests (`serve.cache.ProgramCache` —
+  the class key is exactly the knob set `check_lane_knobs` rejects
+  per-lane, because those are the knobs one lowered program fixes),
+- packs compatible queued requests into fleet lanes
+  (`serve.packer.LanePacker`, deadline-or-full dispatch) and launches
+  them through the cached program with inert-lane padding, per-lane
+  stop times, and heartbeat progress off the single-fetch harvest
+  (`serve.service.SimService`),
+
+returning each request's summary JSON bit-identical to its solo
+`Simulation.run` (tests/test_serve.py pins this end to end).
+
+docs/17-Serving.md is the narrative: request schema, equivalence-class
+table, packer policy, drain semantics, bench methodology.
+"""
+
+from shadow_tpu.serve.cache import ProgramCache
+from shadow_tpu.serve.packer import (
+    ClassKey,
+    LanePacker,
+    ScenarioRequest,
+    equivalence_class,
+    parse_request,
+)
+from shadow_tpu.serve.service import SimService, solo_reference
+
+__all__ = [
+    "ClassKey",
+    "LanePacker",
+    "ProgramCache",
+    "ScenarioRequest",
+    "SimService",
+    "equivalence_class",
+    "parse_request",
+    "solo_reference",
+]

@@ -1,0 +1,456 @@
+"""SimService: the resident multi-tenant scenario executor.
+
+The serving dataflow, request to result:
+
+    submit(doc)          parse + validate; compute the ClassKey; queue
+      |                  on the LanePacker; notify the launch worker
+    _worker_loop         wait until a class is full or its oldest
+      |                  request ages past --pack-deadline-ms
+    _launch(key, reqs)   ProgramCache.get -> warm Fleet (compiled at
+      |                  --max-lanes, per-lane stops, pinned fault pad)
+      |                  make_inputs(plan): live lanes = requests,
+      |                  pad lanes = inert (zero events, counters 0)
+      |                  beat loop: N x step_window, then ONE harvest
+      |                  extract/fetch -> per-lane progress streamed
+      |                  into the result records
+    result(rid)          summary bit-identical to the solo run
+
+Bit-identity rests on the fleet tier's per-lane guarantees plus two
+serving-specific facts, both pinned in tests/test_serve.py:
+
+- per-lane stops: each lane's LAST window truncates at ITS OWN stop
+  (`Fleet(per_lane_stop=True)` vmaps the stop), so packing mixed stop
+  times never changes any lane's window sequence vs its solo run;
+- the stepped drive is the fused drive: `step_window` partitions time
+  at exactly the windows `run`'s while_loop takes, and a finished
+  lane's step is the idempotent done-branch (flush exchange, clamp
+  `now` to stop, NO counter increments) — so after the final
+  confirming step the lane state equals the fused run's output.
+
+Drain (SIGTERM): the worker finishes the launch in flight, stops
+pulling; pending requests persist to --queue-file as re-submittable
+JSON docs; the process exits 0 (`Supervisor.mark_drained`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from shadow_tpu.serve.cache import ProgramCache
+from shadow_tpu.serve.packer import (
+    ClassKey,
+    LanePacker,
+    ScenarioRequest,
+    equivalence_class,
+    parse_request,
+)
+
+
+class ServiceDraining(Exception):
+    """Submit refused: the service is draining (HTTP 503)."""
+
+
+# ------------------------------------------------------------ scenarios
+#
+# The registry maps a request's `model` to its engine-level builder.
+# `build` constructs with a given base seed (the solo path builds with
+# the request seed; the fleet template builds with 0 and binds per-lane
+# seeds — bit-identical, pinned by the fleet tier). `hosts_of` answers
+# (names, host_count) WITHOUT building, so submit-time fault signatures
+# stay cheap.
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    param_names: frozenset
+    build: Callable  # (params: dict, seed: int) -> (engine, state0, names)
+    hosts_of: Callable  # (params: dict) -> (names, n_hosts_global)
+    nic: bool = False  # has a NIC-modelled host tier (bandwidth_scale)
+
+
+def _phold_hosts(params: dict):
+    n = int(params.get("hosts", 8))
+    return [f"host{i}" for i in range(n)], n
+
+
+def _phold_build(params: dict, seed: int):
+    from shadow_tpu.models import phold
+
+    p = dict(params)
+    n = int(p.pop("hosts", 8))
+    eng, init = phold.build(n, seed=seed, **p)
+    return eng, init(), [f"host{i}" for i in range(n)]
+
+
+SCENARIOS: dict[str, Scenario] = {
+    "phold": Scenario(
+        name="phold",
+        param_names=frozenset({
+            "hosts", "capacity", "msgs_per_host", "latency_ns",
+            "mean_delay_ns", "hot_hosts", "hot_weight", "drain_batch",
+            "batched",
+        }),
+        build=_phold_build,
+        hosts_of=_phold_hosts,
+    ),
+}
+
+
+def scenario_for(model: str) -> Scenario:
+    scen = SCENARIOS.get(model)
+    if scen is None:
+        raise ValueError(
+            f"unknown model {model!r}; served models are "
+            f"{sorted(SCENARIOS)}"
+        )
+    return scen
+
+
+def validate_request(req: ScenarioRequest) -> Scenario:
+    """Model-aware validation on top of `parse_request`'s generic one."""
+    scen = scenario_for(req.model)
+    for k, _ in req.params:
+        if k not in scen.param_names:
+            raise ValueError(
+                f"unknown {req.model} param {k!r}; static knobs are "
+                f"{sorted(scen.param_names)}"
+            )
+    if req.bandwidth_scale != 1.0 and not scen.nic:
+        raise ValueError(
+            f"bandwidth_scale needs a NIC-modelled host tier; "
+            f"{req.model} has none — use latency_scale or a bandwidth "
+            "fault instead"
+        )
+    return scen
+
+
+def request_class(req: ScenarioRequest) -> ClassKey:
+    names, hg = scenario_for(req.model).hosts_of(dict(req.params))
+    return equivalence_class(req, names, hg)
+
+
+def solo_reference(doc: dict) -> dict:
+    """The solo-run summary a served result must match bit-for-bit:
+    the scenario built the NATIVE way (request seed in the engine
+    config, faults compiled into the constructor, latency via
+    `scaled_network`) and run through the fused `Engine.run`. This is
+    the serving bit-identity oracle used by tests, the bench, and the
+    serve_smoke gate — deliberately a different code path from the
+    fleet's bind_lane lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.engine import Engine, state_summary
+    from shadow_tpu.faults.schedule import compile_faults
+    from shadow_tpu.runtime.fleet import scaled_network
+
+    req = parse_request(doc, rid="solo", seq=0)
+    scen = validate_request(req)
+    eng, state0, names = scen.build(dict(req.params), req.seed)
+    if req.fault_specs or req.latency_scale != 1.0:
+        net = (scaled_network(eng.network, req.latency_scale)
+               if req.latency_scale != 1.0 else eng.network)
+        comp = None
+        reset = None
+        if req.fault_specs:
+            hg = len(names)
+            comp = compile_faults(req.fault_specs, names, hg, req.seed)
+            if comp.has_crash or comp.has_bw:
+                reset = state0.hosts
+        eng = Engine(eng.cfg, eng.handlers, net,
+                     batch_handler=eng.batch_handler,
+                     faults=comp, fault_reset=reset)
+    run = jax.jit(eng.run)  # shadowlint: no-donate=bit-identity oracle mirrors tests/test_fleet's undonated solo build on purpose
+    final = jax.device_get(run(state0, jnp.int64(req.stop_ns)))  # shadowlint: no-deadline=offline oracle for tests/bench, not on the serving loop
+    return state_summary(final)
+
+
+# -------------------------------------------------------------- service
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One warm program: the compiled fleet, its harvest (the cached
+    extraction jit rides along), and the scenario's host names."""
+
+    key: ClassKey
+    fleet: Any
+    harvest: Any
+    names: list
+
+
+class SimService:
+    """The resident executor: packer + cache + one launch worker.
+
+    `fleet_factory` is injectable for pure-python tests of the
+    submit/pack/drain machinery (it replaces `_build_entry`).
+    """
+
+    def __init__(self, *, max_lanes: int = 8,
+                 pack_deadline_ms: float = 50.0,
+                 max_cached_programs: int = 4, beat_windows: int = 32,
+                 metrics=None, queue_file: str | None = None,
+                 fleet_factory=None, clock=time.monotonic):
+        if max_lanes < 1:
+            raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
+        from shadow_tpu.obs.metrics import ServeMetrics
+
+        self.max_lanes = int(max_lanes)
+        self.beat_windows = max(int(beat_windows), 1)
+        self.queue_file = queue_file
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.cache = ProgramCache(max_cached_programs,
+                                  metrics=self.metrics)
+        self.packer = LanePacker(self.max_lanes,
+                                 pack_deadline_ms / 1000.0, clock=clock)
+        self._fleet_factory = fleet_factory
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._results: dict[str, dict] = {}
+        self._submit_t: dict[str, float] = {}
+        self._seq = 0
+        self._launches = 0
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+
+    # -- request plane ---------------------------------------------------
+
+    def submit(self, doc: dict) -> dict:
+        """Validate, classify, queue. Raises ValueError (HTTP 400) on a
+        bad request, ServiceDraining (503) once draining."""
+        with self._cond:
+            if self._stopping:
+                raise ServiceDraining("service is draining; resubmit "
+                                      "to the next instance")
+            seq = self._seq
+            self._seq += 1
+        rid = f"r{seq:06d}"
+        req = parse_request(doc, rid=rid, seq=seq)
+        validate_request(req)
+        key = request_class(req)
+        self.metrics.inc("serve_requests")
+        with self._cond:
+            self._results[rid] = {
+                "request_id": rid, "status": "queued", "class": str(key),
+            }
+            self._submit_t[rid] = self._clock()
+            self.packer.push(key, req)
+            self.metrics.set("serve_queue_depth", self.packer.depth())
+            self._cond.notify()
+        return {"request_id": rid, "class": str(key)}
+
+    def result(self, rid: str) -> dict | None:
+        with self._cond:
+            rec = self._results.get(rid)
+            return dict(rec) if rec is not None else None
+
+    def queue_snapshot(self) -> dict:
+        with self._cond:
+            launches = self._launches
+            draining = self._stopping
+        return {
+            "packer": self.packer.snapshot(),
+            "cache": self.cache.snapshot(),
+            "launches": launches,
+            "draining": draining,
+        }
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SimService":
+        self._thread = threading.Thread(
+            target=self._worker_loop, name="shadow-tpu-serve-worker",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self) -> dict:
+        """Graceful stop: finish the launch in flight, persist the
+        pending queue, report. Idempotent."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        pending = self.packer.drain_all()
+        self.metrics.set("serve_queue_depth", 0)
+        if self.queue_file is not None:
+            doc = {"version": 1, "pending": [r.doc() for r in pending]}
+            tmp = self.queue_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, sort_keys=True, indent=1)
+                f.write("\n")
+            os.replace(tmp, self.queue_file)
+        return {"persisted": len(pending), "queue_file": self.queue_file}
+
+    def load_queue(self) -> int:
+        """Re-submit requests persisted by a previous drain."""
+        if self.queue_file is None or not os.path.exists(self.queue_file):
+            return 0
+        with open(self.queue_file) as f:
+            doc = json.load(f)
+        n = 0
+        for d in doc.get("pending", []):
+            self.submit(d)
+            n += 1
+        os.remove(self.queue_file)
+        return n
+
+    # -- launch worker ---------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                key = None
+                while not self._stopping:
+                    key = self.packer.ready()
+                    if key is not None:
+                        break
+                    self._cond.wait(timeout=self.packer.next_timeout())
+                if self._stopping:
+                    return
+                reqs = self.packer.pop(key)
+                self.metrics.set("serve_queue_depth",
+                                 self.packer.depth())
+            if not reqs:
+                continue
+            try:
+                self._launch(key, reqs)
+            except Exception as e:  # noqa: BLE001 - one bad batch must not kill the worker
+                self.metrics.inc("serve_errors", len(reqs))
+                with self._cond:
+                    for r in reqs:
+                        self._results[r.rid] = {
+                            "request_id": r.rid, "status": "error",
+                            "error": f"{type(e).__name__}: {e}",
+                            "class": str(key),
+                        }
+            finally:
+                self.metrics.set("serve_inflight", 0)
+
+    def _build_entry(self, key: ClassKey, probe: ScenarioRequest):
+        """Cold path: compile the class's fleet template at max_lanes.
+        The probe request donates its fault specs so the template
+        compiles with the class's fault flags; the pinned fault pad
+        makes every batch in the class bind identically-shaped arrays."""
+        from shadow_tpu.runtime.fleet import Fleet, FleetPlan
+        from shadow_tpu.runtime.harvest import HeartbeatHarvest
+
+        scen = scenario_for(key.model)
+        eng, state0, names = scen.build(dict(key.params), 0)
+        L = self.max_lanes
+        faults = None
+        pad = None
+        if key.fault_sig is not None:
+            pad = (key.fault_sig[0], key.fault_sig[1])
+            faults = (probe.fault_specs,) + ((),) * (L - 1)
+        plan = FleetPlan(lanes=L, seeds=tuple(range(L)), faults=faults,
+                         latency_scale=(1.0,) * L)
+        fleet = Fleet(eng, state0, plan, names=names,
+                      per_lane_stop=True, fault_pad=pad,
+                      strict_overflow=False)
+        return CacheEntry(key=key, fleet=fleet,
+                          harvest=HeartbeatHarvest(fleet), names=names)
+
+    def _batch_plan(self, key: ClassKey, reqs: list, lanes: int):
+        """The packed FleetPlan: live lanes carry the requests' knobs,
+        pad lanes are inert (zero events — counters pinned at zero)."""
+        from shadow_tpu.runtime.fleet import FleetPlan, inert_lane_state
+
+        R = len(reqs)
+        pads = lanes - R
+        faults = None
+        if key.fault_sig is not None:
+            faults = tuple(r.fault_specs for r in reqs) + ((),) * pads
+        bw = None
+        if any(r.bandwidth_scale != 1.0 for r in reqs):
+            bw = (tuple(r.bandwidth_scale for r in reqs)
+                  + (1.0,) * pads)
+
+        def override(i, st):
+            return st if i < R else inert_lane_state(st)
+
+        return FleetPlan(
+            lanes=lanes,
+            seeds=tuple(r.seed for r in reqs) + (0,) * pads,
+            faults=faults,
+            latency_scale=(tuple(r.latency_scale for r in reqs)
+                           + (1.0,) * pads),
+            bandwidth_scale=bw,
+            state_override=override,
+        )
+
+    def _launch(self, key: ClassKey, reqs: list) -> None:
+        import numpy as np
+
+        hits_before = self.cache.hits
+        factory = (self._fleet_factory or self._build_entry)
+        entry = self.cache.get(key, lambda: factory(key, reqs[0]))
+        cache_hit = self.cache.hits > hits_before
+        fleet = entry.fleet
+        L = fleet.lanes
+        R = len(reqs)
+        with self._cond:
+            self._launches += 1
+            launch_no = self._launches
+            for i, r in enumerate(reqs):
+                self._results[r.rid] = {
+                    "request_id": r.rid, "status": "running",
+                    "class": str(key), "lane": i, "launch": launch_no,
+                }
+        self.metrics.inc("serve_launches")
+        self.metrics.inc("serve_lanes", R)
+        self.metrics.set("serve_last_lanes_packed", R)
+        self.metrics.set("serve_inflight", R)
+        if R >= 2:
+            self.metrics.inc("serve_packed_launches")
+
+        st, binds = fleet.make_inputs(self._batch_plan(key, reqs, L))
+        stops = np.asarray([r.stop_ns for r in reqs] + [0] * (L - R),
+                           np.int64)
+        # beat loop: beat_windows fixed-window steps per harvest — the
+        # single-fetch heartbeat that streams per-lane progress
+        while True:
+            for _ in range(self.beat_windows):
+                st = fleet.step_window(st, stops, binds=binds)
+            st, bundle = entry.harvest.extract(st, full=False)
+            fetched = entry.harvest.fetch(bundle)
+            sums = entry.harvest.lane_summaries_from(fetched)
+            with self._cond:
+                for i, r in enumerate(reqs):
+                    rec = self._results[r.rid]
+                    rec["progress"] = sums[i]
+            if all(sums[i]["now_ns"] >= r.stop_ns
+                   for i, r in enumerate(reqs)):
+                break
+        # one confirming step: a lane whose last REAL window landed
+        # exactly on its stop has not yet run the done-branch exchange
+        # flush (the fused run's epilogue); this step fires it for every
+        # lane (idempotent for lanes already done) so the harvested
+        # summaries equal the fused solo run's state_summary bit-for-bit
+        st = fleet.step_window(st, stops, binds=binds)
+        _, bundle = entry.harvest.extract(st, full=False)
+        sums = entry.harvest.lane_summaries_from(
+            entry.harvest.fetch(bundle))
+        done_t = self._clock()
+        with self._cond:
+            for i, r in enumerate(reqs):
+                wall_s = done_t - self._submit_t.pop(r.rid, done_t)
+                self._results[r.rid] = {
+                    "request_id": r.rid, "status": "done",
+                    "summary": sums[i],
+                    "model": r.model, "seed": r.seed,
+                    "stop_ns": r.stop_ns, "class": str(key), "lane": i,
+                    "lanes_packed": R, "launch": launch_no,
+                    "cache_hit": cache_hit,
+                    "wall_ms": round(wall_s * 1e3, 3),
+                }
+                self.metrics.observe_latency_ns(int(wall_s * 1e9))
+        self.metrics.inc("serve_results", R)

@@ -30,6 +30,11 @@ from typing import Any
 
 # artifact type tags
 JSON_T, OPENMETRICS_T, HEARTBEAT_T = "json", "openmetrics", "heartbeat"
+# a served-result record (serve plane, docs/17-Serving.md): JSON with a
+# request_id key wrapping the sim summary — loaded as the EMBEDDED
+# summary so it diffs directly against a solo-run summary with sim keys
+# exact (the serving bit-identity gate)
+SERVED_T = "served"
 
 # numeric keys that are wall-clock (not sim) quantities: always
 # compared with the tolerance, never exactly, because two bit-identical
@@ -47,6 +52,8 @@ def classify(path: str, text: str) -> str:
     if "[shadow-heartbeat]" in text:
         return HEARTBEAT_T
     if stripped.startswith("{") or stripped.startswith("["):
+        if stripped.startswith("{") and '"request_id"' in text:
+            return SERVED_T
         return JSON_T
     if "# EOF" in text or stripped.startswith("# TYPE"):
         return OPENMETRICS_T
@@ -112,6 +119,19 @@ def load_artifact(path: str) -> tuple[str, Any]:
     kind = classify(path, text)
     if kind == JSON_T:
         return kind, json.loads(text)
+    if kind == SERVED_T:
+        doc = json.loads(text)
+        summary = doc.get("summary")
+        if not isinstance(summary, dict):
+            raise ValueError(
+                f"{path}: served result {doc.get('request_id')!r} has "
+                f"no summary (status {doc.get('status')!r}) — only "
+                "completed requests diff against a solo run"
+            )
+        # normalize to a plain summary: sim keys diff exactly against
+        # the solo-run artifact; request metadata (lane, launch,
+        # wall_ms) is serving detail, not run output
+        return JSON_T, summary
     if kind == OPENMETRICS_T:
         return kind, load_openmetrics(text)
     return kind, load_heartbeat(text)

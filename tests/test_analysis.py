@@ -549,6 +549,57 @@ def test_sl112_inline_suppression():
     assert fs == []
 
 
+def test_sl113_blocking_socket_in_dispatch_scopes():
+    # each window-loop drive scope name trips: the socket call parks
+    # the thread in the kernel while the device loop waits behind it
+    fs = _lint("""
+        def dispatch(stop_ns, state, sock):
+            return sock.recv(1024)
+        def run(st, stop, httpd):
+            httpd.serve_forever()
+            return st
+        def step_window(st, stop, conn):
+            return conn.getresponse()
+    """)
+    assert _rules(fs) == ["SL113"] and len(fs) == 3
+
+
+def test_sl113_blocking_socket_in_jit_scope():
+    fs = _lint("""
+        import jax
+        @jax.jit
+        def f(x, sock):
+            data, addr = sock.accept()
+            return x
+    """)
+    assert _rules(fs) == ["SL113"]
+
+
+def test_sl113_silent_on_handler_threads():
+    # the sanctioned discipline: blocking socket work on HTTP handler
+    # threads / plain helper scopes never flags — and a serve_forever
+    # passed as a Thread TARGET (attribute reference, no call) is not a
+    # blocking call site at all
+    fs = _lint("""
+        import threading
+        def do_GET(self):
+            body = self.rfile.recv(4096)
+            return body
+        def start(httpd):
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+    """)
+    assert fs == []
+
+
+def test_sl113_inline_suppression():
+    fs = _lint("""
+        def dispatch(stop, state, sock):
+            return sock.recv(64)  # shadowlint: disable=SL113
+    """)
+    assert fs == []
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng

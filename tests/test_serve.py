@@ -1,0 +1,444 @@
+"""Resident serving pins (ISSUE 16, docs/17-Serving.md).
+
+The contract, layer by layer:
+
+- end-to-end (the headline pin): 16 concurrent mixed requests across
+  two static-knob equivalence classes each return a summary
+  bit-identical to the corresponding solo `Engine.run`, with >= 1
+  launch packing >= 4 lanes and the program cache reporting >= 1 hit
+  per class after warmup — one compiled program per class, probed via
+  `_cache_size`;
+- inert-lane padding: a partial batch launched through a program
+  compiled at max_lanes keeps every pad lane's counters EXACTLY zero;
+- program cache: same knobs -> hit, any knob flip -> miss, eviction at
+  max_cached_programs is LRU and deterministic (injected factory — no
+  compiles);
+- packer: deadline-or-full dispatch, deterministic ordering;
+- request plane: schema validation (HTTP 400 surface), queue/result
+  endpoints, serve-plane /metrics passing validate_openmetrics;
+- drain: SIGTERM semantics — pending queue persisted as re-submittable
+  JSON, reload on next start, `Supervisor.mark_drained` -> exit 0;
+- diff_runs: a served-result record diffs against a solo summary with
+  sim keys exact (the serving bit-identity gate's tooling).
+"""
+
+import json
+import time
+
+import pytest
+
+from shadow_tpu.serve.cache import ProgramCache
+from shadow_tpu.serve.packer import (
+    LanePacker,
+    equivalence_class,
+    parse_request,
+)
+from shadow_tpu.serve.service import (
+    ServiceDraining,
+    SimService,
+    request_class,
+    solo_reference,
+    validate_request,
+)
+
+HOSTS = 8
+PARAMS = {"hosts": HOSTS, "capacity": 64, "msgs_per_host": 2}
+NAMES = [f"host{i}" for i in range(HOSTS)]
+
+
+def _doc(seed, stop_s=0.5, faults=None, lat=None):
+    d = {"model": "phold", "params": dict(PARAMS), "seed": seed,
+         "stop_s": stop_s}
+    if faults:
+        d["faults"] = list(faults)
+    if lat is not None:
+        d["latency_scale"] = lat
+    return d
+
+
+def _req(doc, seq=0):
+    return parse_request(doc, rid=f"r{seq:06d}", seq=seq)
+
+
+def _wait_done(svc, rids, timeout_s=560.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        recs = {r: svc.result(r) for r in rids}
+        if all(x["status"] in ("done", "error") for x in recs.values()):
+            return recs
+        time.sleep(0.2)
+    raise TimeoutError(f"requests still pending: "
+                       f"{[r for r in rids if svc.result(r)['status'] not in ('done', 'error')]}")
+
+
+# --------------------------------------------------------- request schema
+
+
+def test_parse_request_validation_errors():
+    with pytest.raises(ValueError, match="stop_s"):
+        _req({"model": "phold", "params": PARAMS, "seed": 1})
+    with pytest.raises(ValueError, match="unknown request field"):
+        _req({**_doc(1), "bogus": 1})
+    with pytest.raises(ValueError, match="unknown fault type"):
+        _req(_doc(1, faults=["meteor hosts=*"]))
+    with pytest.raises(ValueError, match="latency_scale"):
+        _req({**_doc(1), "latency_scale": -1.0})
+    with pytest.raises(ValueError, match="stop"):
+        _req({**_doc(1), "stop_s": 0.0})
+
+
+def test_validate_request_model_aware():
+    with pytest.raises(ValueError, match="unknown model"):
+        validate_request(_req({**_doc(1), "model": "nosuch"}))
+    with pytest.raises(ValueError, match="static knobs"):
+        validate_request(_req({"model": "phold",
+                               "params": {"warp": 9}, "stop_s": 1.0}))
+    # phold has no NIC tier: bandwidth_scale is a 400, not a crash later
+    with pytest.raises(ValueError, match="bandwidth_scale"):
+        validate_request(_req({**_doc(1), "bandwidth_scale": 0.5}))
+
+
+# ---------------------------------------------------- equivalence classes
+
+
+def test_equivalence_class_keys():
+    base = _req(_doc(seed=1))
+    key = equivalence_class(base, NAMES, HOSTS)
+
+    # per-lane launch inputs never split the class: seed, stop,
+    # latency scale, fault VALUES within the same padded shape
+    assert equivalence_class(_req(_doc(seed=99)), NAMES, HOSTS) == key
+    assert equivalence_class(_req(_doc(1, stop_s=2.0)), NAMES, HOSTS) \
+        == key
+    assert equivalence_class(_req(_doc(1, lat=1.7)), NAMES, HOSTS) == key
+
+    # static knobs split it
+    other = dict(PARAMS, capacity=128)
+    assert equivalence_class(
+        _req({"model": "phold", "params": other, "stop_s": 1.0}),
+        NAMES, HOSTS) != key
+
+    # faults split it (different bind shapes/flags)...
+    crash = equivalence_class(
+        _req(_doc(1, faults=["crash hosts=host1 start=0.1 end=0.2"])),
+        NAMES, HOSTS)
+    assert crash != key and crash.fault_sig is not None
+
+    # ...but schedules rounding to the same pow2 pad share one class:
+    # one crash interval vs two co-timed ones both have 3 time edges,
+    # landing on the same 4-epoch pad
+    crash2 = equivalence_class(
+        _req(_doc(2, faults=["crash hosts=host2 start=0.1 end=0.2",
+                             "crash hosts=host3 start=0.1 end=0.2"])),
+        NAMES, HOSTS)
+    assert crash2 == crash
+
+    # a values-neutral schedule (globs matching nothing) binds no fault
+    # arrays — same class as fault-free
+    ghost = equivalence_class(
+        _req(_doc(1, faults=["crash hosts=nomatch* start=1 end=2"])),
+        NAMES, HOSTS)
+    assert ghost == key
+
+
+# ------------------------------------------------------------- the packer
+
+
+def test_packer_full_beats_deadline():
+    t = [0.0]
+    p = LanePacker(max_lanes=2, deadline_s=10.0, clock=lambda: t[0])
+    a = _req(_doc(1), seq=0)
+    b = _req(_doc(2, faults=["crash hosts=host1 start=0.1 end=0.2"]),
+             seq=1)
+    c = _req(_doc(3), seq=2)
+    ka, kb = request_class(a), request_class(b)
+    p.push(ka, a)
+    p.push(kb, b)
+    assert p.ready() is None  # nobody full, nobody due
+    p.push(ka, c)  # class A fills
+    assert p.ready() == ka
+    assert [r.rid for r in p.pop(ka)] == [a.rid, c.rid]
+    # B launches only once its deadline passes
+    assert p.ready() is None
+    t[0] = 10.5
+    assert p.ready() == kb
+    assert p.next_timeout() == 0.0
+
+
+def test_packer_deterministic_order_and_drain():
+    t = [0.0]
+    p = LanePacker(max_lanes=8, deadline_s=1.0, clock=lambda: t[0])
+    reqs = [_req(_doc(s), seq=s) for s in range(3)]
+    fb = _req(_doc(9, faults=["crash hosts=host1 start=0.1 end=0.2"]),
+              seq=3)
+    for r in reqs:
+        p.push(request_class(r), r)
+    p.push(request_class(fb), fb)
+    t[0] = 2.0  # both classes due: oldest head (seq 0) wins
+    assert p.ready() == request_class(reqs[0])
+    assert p.depth() == 4
+    drained = p.drain_all()
+    assert [r.seq for r in drained] == [0, 1, 2, 3]
+    assert p.depth() == 0 and p.ready() is None
+
+
+# -------------------------------------------------------- program cache
+
+
+def test_program_cache_hit_miss_lru_deterministic():
+    built = []
+
+    def factory(tag):
+        def f():
+            built.append(tag)
+            return f"prog-{tag}"
+        return f
+
+    c = ProgramCache(max_programs=2)
+    assert c.get("A", factory("A")) == "prog-A"
+    assert c.get("A", factory("A")) == "prog-A"
+    assert (c.hits, c.misses, built) == (1, 1, ["A"])
+
+    c.get("B", factory("B"))
+    c.get("A", factory("A"))  # A most-recent: LRU order is now B, A
+    c.get("C", factory("C"))  # evicts B, deterministically
+    assert c.keys() == ["A", "C"]
+    assert c.evictions == 1
+    c.get("B", factory("B"))  # B is a MISS again and evicts A
+    assert built == ["A", "B", "C", "B"]
+    assert c.keys() == ["C", "B"]
+    assert c.hits_by_key["A"] == 2
+    snap = c.snapshot()
+    assert snap["programs"] == 2 and snap["evictions"] == 2
+
+
+# -------------------------------------------------- request plane (no jit)
+
+
+def _quiet_service(**kw):
+    """A service whose packer never fires (huge deadline + lanes), so
+    the request plane is testable without compiling anything."""
+    kw.setdefault("max_lanes", 64)
+    kw.setdefault("pack_deadline_ms", 3_600_000.0)
+    return SimService(**kw)
+
+
+def test_submit_queue_result_endpoints(tmp_path):
+    from shadow_tpu.serve.http import ServeServer
+    import urllib.request
+    import urllib.error
+
+    svc = _quiet_service().start()
+    srv = ServeServer(svc, port=0).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        body = json.dumps(_doc(7)).encode()
+        req = urllib.request.Request(url + "/submit", data=body)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read())
+        rid = out["request_id"]
+        assert out["class"].startswith("phold(")
+
+        with urllib.request.urlopen(f"{url}/result/{rid}",
+                                    timeout=10) as r:
+            assert r.status == 202  # queued: the record streams status
+            assert json.loads(r.read())["status"] == "queued"
+
+        with urllib.request.urlopen(url + "/queue", timeout=10) as r:
+            q = json.loads(r.read())
+        assert q["packer"]["depth"] == 1 and not q["draining"]
+
+        # bad requests are 400 with the reason, unknown ids 404
+        bad = urllib.request.Request(
+            url + "/submit", data=json.dumps({"model": "phold"}).encode())
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=10)
+        assert e.value.code == 400 and "stop" in e.value.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/result/nope", timeout=10)
+        assert e.value.code == 404
+    finally:
+        srv.close()
+        svc.drain()
+
+
+def test_serve_metrics_pass_openmetrics_validation():
+    from shadow_tpu.obs.metrics import validate_openmetrics
+
+    svc = _quiet_service()
+    svc.submit(_doc(1))
+    svc.metrics.observe_latency_ns(12_345)
+    text = svc.metrics.render()
+    assert validate_openmetrics(text) == []
+    assert "shadow_tpu_serve_requests_total 1" in text
+    assert "shadow_tpu_serve_queue_depth 1" in text
+    assert "shadow_tpu_serve_request_latency_ns_count 1" in text
+    totals = svc.metrics.totals()
+    assert totals["shadow_tpu_serve_request_latency_ns_sum"] == 12_345
+
+
+def test_drain_persists_and_reloads_queue(tmp_path):
+    qf = str(tmp_path / "queue.json")
+    svc = _quiet_service(queue_file=qf).start()
+    svc.submit(_doc(5))
+    svc.submit(_doc(6, faults=["crash hosts=host1 start=0.1 end=0.2"]))
+    report = svc.drain()
+    assert report["persisted"] == 2
+    doc = json.loads(open(qf).read())
+    assert [d["seed"] for d in doc["pending"]] == [5, 6]
+    assert doc["pending"][1]["faults"] == [
+        "crash hosts=host1 start=0.1 end=0.2"]
+
+    # draining service refuses new work with the 503 exception
+    with pytest.raises(ServiceDraining):
+        svc.submit(_doc(7))
+
+    # a fresh service restores the queue and consumes the file
+    svc2 = _quiet_service(queue_file=qf)
+    assert svc2.load_queue() == 2
+    assert svc2.packer.depth() == 2
+    import os
+    assert not os.path.exists(qf)
+
+
+def test_supervisor_mark_drained_exit_zero():
+    import signal
+
+    from shadow_tpu.runtime.supervisor import Supervisor
+
+    sup = Supervisor(install_signals=False)
+    sup.stop_signum = signal.SIGTERM
+    assert sup.exit_code() == 128 + signal.SIGTERM
+    sup.mark_drained()
+    assert sup.exit_code() == 0
+    # without a stop request, drained or not, exit is 0
+    assert Supervisor(install_signals=False).exit_code() == 0
+
+
+# ------------------------------------------------------ diff_runs gate
+
+
+def test_diff_runs_served_vs_solo(tmp_path):
+    from shadow_tpu.tools import diff_runs as D
+
+    summary = {"now_ns": 500_000_000, "windows": 10, "executed": 160,
+               "sweeps": 40, "queue_drops": 0}
+    served = {"request_id": "r000001", "status": "done",
+              "summary": dict(summary), "lane": 2, "lanes_packed": 4,
+              "wall_ms": 12.5, "cache_hit": True}
+    a = tmp_path / "served.json"
+    b = tmp_path / "solo.json"
+    a.write_text(json.dumps(served))
+    b.write_text(json.dumps(summary))
+
+    assert D.classify(str(a), a.read_text()) == D.SERVED_T
+    assert D.classify(str(b), b.read_text()) == D.JSON_T
+    # the served record diffs against the bare solo summary: sim keys
+    # exact, request metadata (lane, wall_ms) invisible
+    assert D.diff_files(str(a), str(b), rtol=0.0) == []
+
+    # any sim-key drift is caught exactly
+    drifted = dict(served, summary=dict(summary, executed=161))
+    a.write_text(json.dumps(drifted))
+    entries = D.diff_files(str(a), str(b), rtol=0.0)
+    assert [e["key"] for e in entries] == ["executed"]
+
+    # an incomplete record refuses to diff rather than passing vacuously
+    a.write_text(json.dumps({"request_id": "r9", "status": "running"}))
+    with pytest.raises(ValueError, match="no summary"):
+        D.load_artifact(str(a))
+
+
+# ----------------------------------------------- end-to-end (compiling)
+
+
+@pytest.mark.slow  # two fleet compiles + 16 solo oracle compiles; the
+# tier-1 lane keeps the full pure-python serving surface above
+def test_serving_16_mixed_requests_bit_identical():
+    """The ISSUE 16 acceptance pin: 16 concurrent mixed requests, two
+    equivalence classes, every summary bit-identical to its solo run,
+    >= 1 launch packing >= 4 lanes, >= 1 cache hit per class, ONE
+    compiled program per class (jit cache-size probe)."""
+    docs = []
+    for i in range(16):
+        if i % 2 == 0:
+            docs.append(_doc(seed=100 + i, stop_s=0.5))
+        else:
+            docs.append(_doc(
+                seed=100 + i,
+                stop_s=0.5 if i % 4 == 1 else 0.375,
+                faults=[f"crash hosts=host{i % HOSTS} start=0.1 end=0.3"],
+                lat=1.5 if i % 4 == 3 else None,
+            ))
+    svc = SimService(max_lanes=4, pack_deadline_ms=250,
+                     beat_windows=8).start()
+    try:
+        rids = [svc.submit(d)["request_id"] for d in docs]
+        recs = _wait_done(svc, rids)
+    finally:
+        svc.drain()
+
+    assert all(r["status"] == "done" for r in recs.values()), recs
+    for d, rid in zip(docs, rids):
+        assert recs[rid]["summary"] == solo_reference(d), \
+            f"{rid} diverged from its solo run"
+
+    # two classes, >= 1 launch packing >= 4 lanes
+    classes = {r["class"] for r in recs.values()}
+    assert len(classes) == 2
+    assert max(r["lanes_packed"] for r in recs.values()) >= 4
+
+    # warm cache: >= 1 hit per class, exactly one compiled program per
+    # class — the jit cache-size probe says relaunches NEVER retraced
+    snap = svc.cache.snapshot()
+    assert snap["misses"] == 2 and snap["programs"] == 2
+    assert all(h >= 1 for h in svc.cache.hits_by_key.values())
+    assert len(svc.cache.hits_by_key) == 2
+    for key in svc.cache.keys():
+        fleet = svc.cache.get(key, lambda: None).fleet
+        assert fleet._jit_step_fixed._cache_size() == 1
+
+    # requests that rode a warm launch say so
+    assert any(r["cache_hit"] for r in recs.values())
+
+
+@pytest.mark.slow  # one fleet compile
+def test_inert_lane_padding_counters_exactly_zero():
+    """Satellite pin: a partial batch through a max_lanes program keeps
+    every pad lane's counters EXACTLY zero — the packer reuses one
+    compiled program across batch sizes instead of recompiling."""
+    import jax
+    import numpy as np
+
+    from shadow_tpu.models import phold
+    from shadow_tpu.runtime.fleet import (
+        Fleet,
+        FleetPlan,
+        inert_lane_state,
+        lane_summary_refs,
+    )
+
+    eng, init = phold.build(HOSTS, seed=0, capacity=64, msgs_per_host=2)
+    plan = FleetPlan(lanes=4, seeds=(0, 1, 2, 3),
+                     latency_scale=(1.0,) * 4)
+    fleet = Fleet(eng, init(), plan, names=NAMES, per_lane_stop=True,
+                  strict_overflow=False)
+
+    live = 2
+    batch = FleetPlan(
+        lanes=4, seeds=(7, 8, 0, 0), latency_scale=(1.0,) * 4,
+        state_override=lambda i, st: st if i < live
+        else inert_lane_state(st),
+    )
+    st, binds = fleet.make_inputs(batch)
+    stops = np.asarray([500_000_000, 375_000_000, 0, 0], np.int64)
+    final = fleet.run(stops, state=st, binds=binds)
+    sums = jax.device_get(lane_summary_refs(final))
+    for k in range(live, 4):
+        for name in ("windows", "executed", "sweeps", "queue_drops"):
+            assert int(sums[name][k]) == 0, (name, k)
+        assert int(sums["now_ns"][k]) == 0
+    # the live lanes actually ran, each to its OWN stop
+    assert int(sums["executed"][0]) > 0
+    assert int(sums["now_ns"][0]) == 500_000_000
+    assert int(sums["now_ns"][1]) == 375_000_000
