@@ -945,6 +945,245 @@ def serve_smoke_worker():
         sys.exit(1)
 
 
+def serve_chaos_worker():
+    """`bench.py --serve-chaos` (measure_all.sh serve_chaos stage,
+    docs/17-Serving.md "Failure semantics"): failure-domain acceptance
+    for the resident service, against a REAL serve subprocess.
+
+    One `SHADOW_TPU_SERVE_CHAOS` spec drives the whole scenario:
+    `raise:beat=2` (in-process retry resumes from the beat-1 snapshot),
+    `kill:beat=4` (SIGKILL mid-batch; the harness relaunches serve and
+    `resume_pending_batch` picks the batch up from the beat-3 snapshot
+    under the ORIGINAL request ids — the restart MTTR number), and
+    `poison:seed=905` (wave B: bisection isolates the poison request).
+    The one-shot marker files live next to the snapshot, so the raise
+    and kill injectors stay fired across the relaunch while the poison
+    keeps firing — exactly what bisection needs.
+
+    Acceptance: every non-poison request completes `done` with a
+    summary that diffs EXACTLY (tools/diff_runs, drift count 0) against
+    its `solo_reference`; wave-A records carry `resumed_from_beat` in
+    (0, beats) — windows re-executed strictly fewer than completed; the
+    poison request alone is `status:"error"`; the drained serve exits 0."""
+    import re as _re
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        _REPO, ".jax_cache_cpu")
+    _enable_compile_cache()
+
+    from shadow_tpu.serve.service import solo_reference
+    from shadow_tpu.tools.diff_runs import diff_files
+    from shadow_tpu.tools.serve_client import request_docs
+
+    work = tempfile.mkdtemp(prefix="shadow_tpu_serve_chaos_")
+    snap = os.path.join(work, "snap.npz")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHADOW_TPU_SERVE_CHAOS"] = (
+        "raise:beat=2;kill:beat=4;poison:seed=905")
+    argv = [sys.executable, "-m", "shadow_tpu", "serve",
+            "--port", "0", "--max-lanes", "4",
+            "--pack-deadline-ms", "600000", "--beat-windows", "2",
+            "--snapshot-beats", "1", "--snapshot-path", snap,
+            "--launch-retries", "1",
+            "--queue-file", os.path.join(work, "queue.json"),
+            "--diag-dir", work]
+
+    def _spawn(tag: str):
+        """Start serve, tail its stderr for the listening line, return
+        (proc, base_url, stderr_path)."""
+        err_path = os.path.join(work, f"{tag}.err")
+        err_f = open(err_path, "wb")
+        proc = subprocess.Popen(argv, cwd=_REPO, env=env,
+                                stdout=subprocess.DEVNULL, stderr=err_f)
+        deadline = time.monotonic() + max(min(_remaining(), 300), 60)
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serve ({tag}) died rc={proc.returncode} before "
+                    f"listening; stderr: {open(err_path).read()[-2000:]}")
+            m = _re.search(r"listening http://([\d.]+):(\d+)/",
+                           open(err_path).read())
+            if m:
+                return proc, f"http://{m.group(1)}:{m.group(2)}", err_path
+            time.sleep(0.1)
+        raise TimeoutError(f"serve ({tag}) never printed a listening line")
+
+    def _http(url, data=None):
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8")
+
+    def _submit(base, doc):
+        code, body = _http(base + "/submit",
+                           json.dumps(doc).encode("utf-8"))
+        if code != 200:
+            raise RuntimeError(f"/submit -> {code}: {body}")
+        return json.loads(body)["request_id"]
+
+    def _poll(proc, base, rids, *, allow_death=False):
+        """Poll until every rid is terminal. Returns (records, died):
+        records is None when the process died first (the SIGKILL leg)."""
+        recs = {}
+        deadline = time.monotonic() + max(min(_remaining(), 600), 120)
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                if allow_death:
+                    return None, True
+                raise RuntimeError(
+                    f"serve died rc={proc.returncode} mid-wave")
+            done = True
+            for rid in rids:
+                try:
+                    code, body = _http(f"{base}/result/{rid}")
+                except OSError:
+                    # connection reset mid-request: serve is dying (the
+                    # SIGKILL leg) or busy — the next iteration's
+                    # proc.poll() decides which
+                    done = False
+                    break
+                rec = json.loads(body)
+                recs[rid] = rec
+                if rec.get("status") not in ("done", "error", "timeout"):
+                    done = False
+            if done:
+                return recs, False
+            time.sleep(0.2)
+        raise TimeoutError(f"wave never finished: "
+                           f"{ {r: recs.get(r, {}).get('status') for r in rids} }")
+
+    # one equivalence class throughout: 4-lane full packs dispatch
+    # immediately despite the effectively-infinite pack deadline
+    wave_a = request_docs(4, mix="plain", hosts=8, stop_s=0.5, seed0=901)
+    wave_b = request_docs(3, mix="plain", hosts=8, stop_s=0.5, seed0=911)
+    poison = request_docs(1, mix="plain", hosts=8, stop_s=0.5,
+                          seed0=905)[0]
+
+    def _diff_drift(rec, doc) -> int:
+        a = os.path.join(work, f"rec_{rec['request_id']}.json")
+        b = os.path.join(work, f"solo_{doc['seed']}.json")
+        with open(a, "w") as f:
+            json.dump(rec, f)
+        with open(b, "w") as f:
+            json.dump(solo_reference(doc), f)
+        return len(diff_files(a, b, rtol=0.1))
+
+    out: dict = {}
+    proc2 = None
+    try:
+        # -- wave A: raise at beat 2 (in-process retry), then SIGKILL
+        #    at beat 4 mid-batch, relaunch, resume, complete ----------
+        _stamp("serve_chaos: wave A (raise -> SIGKILL -> resume)")
+        proc1, base1, _ = _spawn("serve1")
+        rids_a = [_submit(base1, d) for d in wave_a]
+        recs, died = _poll(proc1, base1, rids_a, allow_death=True)
+        t_death = time.monotonic()
+        proc1.wait()
+        out["serve_chaos_killed_rc"] = proc1.returncode
+        if not died:
+            raise RuntimeError("kill:beat=4 never fired — wave A "
+                               "finished on the first serve instance")
+
+        proc2, base2, _ = _spawn("serve2")
+        out["serve_chaos_restart_mttr_s"] = round(
+            time.monotonic() - t_death, 3)
+        recs, _ = _poll(proc2, base2, rids_a)
+        out["serve_chaos_recovery_wall_s"] = round(
+            time.monotonic() - t_death, 3)
+
+        resumed = [r.get("resumed_from_beat") for r in recs.values()]
+        drift_a = sum(_diff_drift(recs[rid], d)
+                      for rid, d in zip(rids_a, wave_a)
+                      if recs[rid]["status"] == "done")
+        out.update({
+            "serve_chaos_wave_a_done": sum(
+                1 for r in recs.values() if r["status"] == "done"),
+            "serve_chaos_resumed_from_beat": resumed[0],
+            "serve_chaos_drift_a": drift_a,
+        })
+        wave_a_ok = (
+            out["serve_chaos_wave_a_done"] == 4 and drift_a == 0
+            and all(isinstance(b, int) and 0 < b < r["beats"]
+                    for b, r in zip(resumed, recs.values())))
+
+        # -- wave B: poison request -> bisection isolates it ----------
+        _stamp("serve_chaos: wave B (poison -> bisection)")
+        rids_b = [_submit(base2, d) for d in wave_b]
+        rid_p = _submit(base2, poison)
+        recs_b, _ = _poll(proc2, base2, rids_b + [rid_p])
+        drift_b = sum(_diff_drift(recs_b[rid], d)
+                      for rid, d in zip(rids_b, wave_b)
+                      if recs_b[rid]["status"] == "done")
+        poison_rec = recs_b[rid_p]
+        out.update({
+            "serve_chaos_wave_b_done": sum(
+                1 for r in rids_b if recs_b[r]["status"] == "done"),
+            "serve_chaos_poison_isolated": bool(
+                poison_rec["status"] == "error"
+                and "poison seed 905" in poison_rec.get("error", "")),
+            "serve_chaos_drift_b": drift_b,
+        })
+
+        # counters from the live scrape: the injectors, the retry, the
+        # resume, and the two bisection levels all actually happened
+        _, metrics = _http(base2 + "/metrics")
+
+        def _counter(name):
+            m = _re.search(rf"^{name}_total ([\d.e+]+)$", metrics,
+                           _re.MULTILINE)
+            return int(float(m.group(1))) if m else -1
+
+        out.update({
+            "serve_chaos_bisections": _counter(
+                "shadow_tpu_serve_bisections"),
+            "serve_chaos_resumes": _counter("shadow_tpu_serve_resumes"),
+            "serve_chaos_launch_retries": _counter(
+                "shadow_tpu_serve_launch_retries"),
+        })
+
+        proc2.send_signal(signal.SIGTERM)
+        out["serve_chaos_drain_rc"] = proc2.wait(timeout=60)
+        proc2 = None
+
+        ok = bool(
+            wave_a_ok
+            and out["serve_chaos_wave_b_done"] == 3 and drift_b == 0
+            and out["serve_chaos_poison_isolated"]
+            and out["serve_chaos_bisections"] >= 2
+            and out["serve_chaos_resumes"] >= 1
+            and out["serve_chaos_launch_retries"] >= 1
+            and out["serve_chaos_drain_rc"] == 0)
+        out["serve_chaos_ok"] = ok
+        print(json.dumps(out), flush=True)
+        print(f"serve_chaos: restart MTTR "
+              f"{out['serve_chaos_restart_mttr_s']}s, resumed from beat "
+              f"{out['serve_chaos_resumed_from_beat']}, "
+              f"{out['serve_chaos_bisections']} bisections, drift "
+              f"{drift_a}+{drift_b} -> {'ok' if ok else 'FAIL'}",
+              file=sys.stderr, flush=True)
+        if not ok:
+            sys.exit(1)
+        shutil.rmtree(work, ignore_errors=True)
+    finally:
+        if proc2 is not None and proc2.poll() is None:
+            proc2.kill()
+        if os.path.isdir(work):  # kept on failure, for the stderr tails
+            print(f"serve_chaos: artifacts kept at {work}",
+                  file=sys.stderr, flush=True)
+
+
 def multichip_worker():
     """Weak-scaling PHOLD over an 8-device mesh — MULTICHIP_r*.json
     carries data now, not just a smoke bit.
@@ -1737,6 +1976,7 @@ def main():
                      ("--fleet", fleet_worker),
                      ("--fleet-smoke", fleet_smoke_worker),
                      ("--serve-smoke", serve_smoke_worker),
+                     ("--serve-chaos", serve_chaos_worker),
                      ("--perf-smoke", perf_smoke),
                      ("--multichip-worker", multichip_worker),
                      ("--chaos-worker", chaos_worker),

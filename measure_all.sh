@@ -259,6 +259,16 @@ print(json.dumps({
 }))
 PYEOF
 echo "=== serve_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
+# serve chaos: failure-domain acceptance for the resident service
+# (docs/17-Serving.md "Failure semantics") against a real serve
+# subprocess with SHADOW_TPU_SERVE_CHAOS armed — injected exception at
+# beat 2 (in-process retry from the beat snapshot), SIGKILL mid-batch
+# at beat 4 (harness relaunch, resume_pending_batch under the original
+# request ids, restart MTTR), then a poison request that bisection
+# isolates. Every non-poison result must diff EXACTLY against its
+# solo_reference via tools/diff_runs, and the recovered records must
+# show resumed_from_beat < beats (windows re-executed < completed).
+run serve_chaos 900 --serve-chaos JAX_PLATFORMS=cpu BENCH_BUDGET_S=840
 # perf smoke: a small CPU-backend PHOLD, a small tgen TCP workload
 # under the frontier drain, and an 8-lane PHOLD fleet, each against its
 # checked-in PERF_FLOOR.json floor — fails (exit 1) when any of the

@@ -173,6 +173,39 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--beat-windows", type=int, default=32, metavar="N",
                    help="serve mode: simulation windows per progress "
                         "heartbeat (one single-fetch harvest per beat)")
+    p.add_argument("--snapshot-beats", type=int, default=0, metavar="N",
+                   help="serve mode: persist the in-flight batch (fleet "
+                        "state + manifest) to --snapshot-path every N "
+                        "beats; a failed or crashed launch resumes from "
+                        "the last snapshot instead of window 0 (0=off; "
+                        "docs/17-Serving.md 'Failure semantics')")
+    p.add_argument("--snapshot-path",
+                   default="shadow_tpu.serve.snapshot.npz",
+                   help="serve mode: beat-snapshot file (checkpoint v7 "
+                        "with a serve-batch manifest header)")
+    p.add_argument("--launch-retries", type=int, default=1, metavar="N",
+                   help="serve mode: retries per launch (exponential "
+                        "backoff, resuming from the newest snapshot); "
+                        "once exhausted a multi-request batch bisects "
+                        "to isolate the poison request")
+    p.add_argument("--launch-deadline-s", type=float, default=0.0,
+                   metavar="S",
+                   help="serve mode: per-beat wall deadline — a wedged "
+                        "launch aborts the process with the retryable "
+                        "stall exit (75) and a diagnostic bundle, so an "
+                        "outer --retry relaunch resumes the batch from "
+                        "its snapshot (0=off)")
+    p.add_argument("--result-ttl-s", type=float, default=0.0, metavar="S",
+                   help="serve mode: evict terminal (done/error/timeout) "
+                        "result records not polled for S seconds (0 = "
+                        "no TTL; queued/running records never evict)")
+    p.add_argument("--max-results", type=int, default=65536, metavar="N",
+                   help="serve mode: LRU cap on retained terminal result "
+                        "records")
+    p.add_argument("--degraded-after", type=int, default=3, metavar="N",
+                   help="serve mode: consecutive terminal launch "
+                        "failures before /healthz reports degraded and "
+                        "/submit returns 503 (a later success recovers)")
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
                    help="write a checkpoint every N sim seconds (0=off). "
                         "Independent of the interval, SIGINT/SIGTERM "
@@ -550,8 +583,20 @@ def _run_serve(args) -> int:
         max_cached_programs=args.max_cached_programs,
         beat_windows=args.beat_windows,
         queue_file=args.queue_file,
+        snapshot_beats=args.snapshot_beats,
+        snapshot_path=args.snapshot_path,
+        launch_retries=args.launch_retries,
+        launch_deadline_s=args.launch_deadline_s,
+        result_ttl_s=args.result_ttl_s,
+        max_results=args.max_results,
+        degraded_after=args.degraded_after,
+        diag_dir=args.diag_dir,
     )
     with Supervisor(label="shadow_tpu-serve") as sup:
+        # resume BEFORE reloading the drained queue: the crashed batch
+        # must reach the worker ahead of any re-packed queue traffic,
+        # or a completing queue batch would clear its snapshot
+        svc.resume_pending_batch()
         restored = svc.load_queue()
         if restored:
             print(f"serve: restored {restored} pending request(s) from "

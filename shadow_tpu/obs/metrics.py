@@ -485,6 +485,30 @@ SERVE_SPECS: tuple[MetricSpec, ...] = (
     MetricSpec(_P + "serve_last_lanes_packed", "gauge",
                "Live lanes in the most recent launch.",
                "host-side: packer launch loop"),
+    MetricSpec(_P + "serve_launch_retries", "counter",
+               "Launch attempts retried after an exception or stall.",
+               "host-side: _run_batch retry loop"),
+    MetricSpec(_P + "serve_bisections", "counter",
+               "Batches split in half to isolate a poison request.",
+               "host-side: _run_batch bisection"),
+    MetricSpec(_P + "serve_timeouts", "counter",
+               "Requests returned status=timeout past deadline_ms.",
+               "host-side: beat-loop deadline masking"),
+    MetricSpec(_P + "serve_snapshots", "counter",
+               "Beat-boundary lane snapshots written.",
+               "host-side: --snapshot-beats cadence"),
+    MetricSpec(_P + "serve_resumes", "counter",
+               "Launches resumed from a beat-boundary snapshot.",
+               "host-side: snapshot load on retry/restart"),
+    MetricSpec(_P + "serve_results_evicted", "counter",
+               "Terminal result records evicted (TTL / LRU cap).",
+               "host-side: --result-ttl-s / --max-results"),
+    MetricSpec(_P + "serve_chaos_injected", "counter",
+               "Faults injected by SHADOW_TPU_SERVE_CHAOS.",
+               "host-side: serve.chaos.ServeChaos"),
+    MetricSpec(_P + "serve_degraded", "gauge",
+               "1 while repeated launch failures hold /submit at 503.",
+               "host-side: _run_batch failure streak"),
 )
 
 _SERVE_HIST = _P + "serve_request_latency_ns"

@@ -610,6 +610,22 @@ class Fleet:
         self._owned[id(state)] = state
         return state
 
+    def adopt_state(self, state):
+        """Register an externally built [L, ...] state tree as owned by
+        this fleet, so `step_window` may donate it without a defensive
+        copy. The serving plane's snapshot-resume path loads a tree
+        through `utils.checkpoint.load_checkpoint` (host numpy leaves)
+        and adopts it in place of the `make_inputs` state.
+
+        The copy below is load-bearing: on the CPU backend
+        `jnp.asarray` can alias the caller's numpy buffer zero-copy,
+        and donating an aliased buffer lets XLA write into memory it
+        does not own (heap corruption, silently wrong resumed lanes).
+        `jnp.array(..., copy=True)` forces a JAX-owned buffer that is
+        safe to donate."""
+        state = jax.tree.map(lambda x: jnp.array(x, copy=True), state)
+        return self._note_owned(state)
+
 
 def inert_lane_state(state):
     """A zero-event lane state: every queue slot emptied (time ==

@@ -129,6 +129,7 @@ class Watchdog:
         self._last_pet = time.monotonic()
         self._progress: dict = {}
         self._n_pets = 0
+        self._armed = True
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.fired = False
@@ -158,6 +159,22 @@ class Watchdog:
             if progress:
                 self._progress.update(progress)
 
+    def arm(self) -> None:
+        """(Re-)enable the deadline with a fresh clock. A resident
+        process (the serving plane) keeps ONE watchdog for its lifetime
+        and arms it per launch — a watchdog per launch would leak a
+        thread each batch."""
+        with self._lock:
+            self._last_pet = time.monotonic()
+            self._armed = True
+
+    def disarm(self) -> None:
+        """Suspend the deadline: idle time between launches must not
+        fire. The thread keeps polling; `arm()` re-enables it with a
+        fresh clock."""
+        with self._lock:
+            self._armed = False
+
     def margin_s(self) -> float:
         """Seconds of deadline left before the next firing — the
         supervisor heartbeat's stall-margin column."""
@@ -186,6 +203,8 @@ class Watchdog:
         poll = min(1.0, max(self.timeout_s / 4.0, 0.05))
         while not self._stop.wait(poll):
             with self._lock:
+                if not self._armed:
+                    continue
                 stalled_for = time.monotonic() - self._last_pet
             if stalled_for > self.timeout_s:
                 if self.compile_grace and self._main_thread_compiling():

@@ -32,13 +32,24 @@ from shadow_tpu.serve.packer import (
     equivalence_class,
     parse_request,
 )
-from shadow_tpu.serve.service import SimService, solo_reference
+from shadow_tpu.serve.chaos import ServeChaos
+from shadow_tpu.serve.service import (
+    ServiceDegraded,
+    ServiceDraining,
+    ServiceUnavailable,
+    SimService,
+    solo_reference,
+)
 
 __all__ = [
     "ClassKey",
     "LanePacker",
     "ProgramCache",
     "ScenarioRequest",
+    "ServeChaos",
+    "ServiceDegraded",
+    "ServiceDraining",
+    "ServiceUnavailable",
     "SimService",
     "equivalence_class",
     "parse_request",

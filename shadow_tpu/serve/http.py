@@ -5,12 +5,14 @@ daemon thread, `BaseHandler` discipline: HTTP/1.1 + Content-Length,
 silent logs). Endpoints:
 
 - POST /submit       scenario request JSON -> {"request_id", "class"}
-                     (400 on a bad request, 503 once draining)
-- GET  /result/<id>  200 done/error record, 202 while queued/running
-                     (the record carries streamed progress), 404 unknown
+                     (400 on a bad request, 503 draining or degraded)
+- GET  /result/<id>  200 done/error/timeout record, 202 while
+                     queued/running (the record carries streamed
+                     progress), 404 unknown
 - GET  /queue        packer + cache + launch snapshot
 - GET  /metrics      serve-plane OpenMetrics (`ServeMetrics.render`)
-- GET  /healthz      {"status": "ok" | "draining"}
+- GET  /healthz      {"status": "ok" | "draining" | "degraded"};
+                     only "ok" is HTTP 200
 
 Blocking socket work (accept/recv inside ThreadingHTTPServer) happens
 ONLY on handler threads — never on the launch worker or anywhere jit
@@ -25,7 +27,7 @@ import sys
 import threading
 
 from shadow_tpu.obs.server import BaseHandler
-from shadow_tpu.serve.service import ServiceDraining, SimService
+from shadow_tpu.serve.service import ServiceUnavailable, SimService
 
 _MAX_BODY = 1 << 20  # a scenario request is a few hundred bytes
 
@@ -52,7 +54,7 @@ class ServeHandler(BaseHandler):
                 raise ValueError(f"body of {n} bytes exceeds {_MAX_BODY}")
             doc = json.loads(self.rfile.read(n) or b"{}")
             out = self._svc.submit(doc)
-        except ServiceDraining as e:
+        except ServiceUnavailable as e:
             self._send(503, _json_bytes({"error": str(e)}),
                        "application/json")
             return
@@ -73,7 +75,8 @@ class ServeHandler(BaseHandler):
                                              f"id {rid!r}"}),
                            "application/json")
             else:
-                status = 200 if rec["status"] in ("done", "error") else 202
+                status = (200 if rec["status"] in ("done", "error",
+                                                   "timeout") else 202)
                 self._send(status, _json_bytes(rec), "application/json")
         elif path == "/queue":
             self._send(200, _json_bytes(svc.queue_snapshot()),
@@ -82,11 +85,9 @@ class ServeHandler(BaseHandler):
             body = svc.metrics.render().encode("utf-8")
             self._send(200, body, self.OPENMETRICS_CT)
         elif path == "/healthz":
-            draining = svc.queue_snapshot()["draining"]
-            self._send(200 if not draining else 503,
-                       _json_bytes({"status": "draining" if draining
-                                    else "ok"}),
-                       "application/json")
+            health = svc.health()
+            self._send(200 if health["status"] == "ok" else 503,
+                       _json_bytes(health), "application/json")
         else:
             self._send(404, b"not found\n", "text/plain")
 

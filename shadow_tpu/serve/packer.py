@@ -42,10 +42,11 @@ class ScenarioRequest:
     fault_specs: tuple  # parsed FaultSpec tuple
     latency_scale: float = 1.0
     bandwidth_scale: float = 1.0
+    deadline_ms: float = 0.0  # 0 = no deadline (wall, from submit time)
 
     def doc(self) -> dict:
         """The re-submittable JSON form (drain persistence / replay)."""
-        return {
+        out = {
             "model": self.model,
             "params": dict(self.params),
             "seed": self.seed,
@@ -54,6 +55,9 @@ class ScenarioRequest:
             "latency_scale": self.latency_scale,
             "bandwidth_scale": self.bandwidth_scale,
         }
+        if self.deadline_ms:
+            out["deadline_ms"] = self.deadline_ms
+        return out
 
 
 def parse_request(doc: dict, *, rid: str, seq: int) -> ScenarioRequest:
@@ -62,7 +66,7 @@ def parse_request(doc: dict, *, rid: str, seq: int) -> ScenarioRequest:
     if not isinstance(doc, dict):
         raise ValueError("request body must be a JSON object")
     known = {"model", "params", "seed", "stop_s", "stop_ns", "faults",
-             "latency_scale", "bandwidth_scale"}
+             "latency_scale", "bandwidth_scale", "deadline_ms"}
     for k in doc:
         if k not in known:
             raise ValueError(
@@ -102,13 +106,16 @@ def parse_request(doc: dict, *, rid: str, seq: int) -> ScenarioRequest:
     bw = float(doc.get("bandwidth_scale", 1.0))
     if bw <= 0:
         raise ValueError(f"bandwidth_scale {bw} <= 0")
+    ddl = float(doc.get("deadline_ms", 0.0))
+    if ddl < 0:
+        raise ValueError(f"deadline_ms {ddl} < 0 (0 disables)")
     return ScenarioRequest(
         rid=rid, seq=seq, model=model,
         params=tuple(sorted(params.items())),
         seed=int(doc.get("seed", 0)), stop_ns=stop_ns,
         fault_dsl=tuple(str(f) for f in fault_dsl),
         fault_specs=tuple(specs),
-        latency_scale=lat, bandwidth_scale=bw,
+        latency_scale=lat, bandwidth_scale=bw, deadline_ms=ddl,
     )
 
 

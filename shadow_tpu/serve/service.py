@@ -6,13 +6,18 @@ The serving dataflow, request to result:
       |                  on the LanePacker; notify the launch worker
     _worker_loop         wait until a class is full or its oldest
       |                  request ages past --pack-deadline-ms
+    _run_batch(key,reqs) supervised launch: retry with backoff from the
+      |                  newest beat snapshot; after --launch-retries
+      |                  failures bisect the batch to isolate poison
     _launch(key, reqs)   ProgramCache.get -> warm Fleet (compiled at
       |                  --max-lanes, per-lane stops, pinned fault pad)
       |                  make_inputs(plan): live lanes = requests,
       |                  pad lanes = inert (zero events, counters 0)
       |                  beat loop: N x step_window, then ONE harvest
       |                  extract/fetch -> per-lane progress streamed
-      |                  into the result records
+      |                  into the result records; every --snapshot-beats
+      |                  harvests the [L,...] state tree + batch
+      |                  manifest persist through utils.checkpoint (v7)
     result(rid)          summary bit-identical to the solo run
 
 Bit-identity rests on the fleet tier's per-lane guarantees plus two
@@ -27,9 +32,30 @@ serving-specific facts, both pinned in tests/test_serve.py:
   `now` to stop, NO counter increments) — so after the final
   confirming step the lane state equals the fused run's output.
 
+Resuming from a snapshot preserves it too: a snapshot is taken at a
+beat boundary (a window boundary by construction), so re-entering the
+beat loop at `beats_done` replays exactly the windows the failed
+attempt had not completed — the window sequence is identical, just
+split across two processes.
+
+Failure-domain isolation (docs/17-Serving.md "Failure semantics"):
+an exception or watchdog-stalled launch retries with exponential
+backoff from the newest snapshot; once retries are exhausted a
+multi-request batch is BISECTED — halves relaunched as fresh batches —
+so one poison request ends as a single error record while every rider
+completes. Requests carry an optional wall `deadline_ms`: lanes past
+deadline are masked out of the progress predicate and returned as
+`status: "timeout"` with their last harvested partial summary.
+Repeated terminal failures flip `/healthz` to degraded and `/submit`
+to 503 (the queue persists as in a drain).
+
 Drain (SIGTERM): the worker finishes the launch in flight, stops
 pulling; pending requests persist to --queue-file as re-submittable
-JSON docs; the process exits 0 (`Supervisor.mark_drained`).
+JSON docs; the process exits 0 (`Supervisor.mark_drained`). A crash
+(SIGKILL, watchdog `os._exit`) persists nothing — but the in-flight
+batch's snapshot file survives, and `resume_pending_batch()` on the
+next start re-registers its requests (original rids) and completes
+them from the last beat boundary.
 """
 
 from __future__ import annotations
@@ -37,8 +63,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable
 
 from shadow_tpu.serve.cache import ProgramCache
@@ -51,8 +79,16 @@ from shadow_tpu.serve.packer import (
 )
 
 
-class ServiceDraining(Exception):
+class ServiceUnavailable(Exception):
+    """Submit refused; the HTTP plane maps any subclass to 503."""
+
+
+class ServiceDraining(ServiceUnavailable):
     """Submit refused: the service is draining (HTTP 503)."""
+
+
+class ServiceDegraded(ServiceUnavailable):
+    """Submit refused: repeated launch failures; resubmit later (503)."""
 
 
 # ------------------------------------------------------------ scenarios
@@ -190,13 +226,30 @@ class SimService:
 
     `fleet_factory` is injectable for pure-python tests of the
     submit/pack/drain machinery (it replaces `_build_entry`).
+
+    Every robustness knob defaults OFF (snapshot_beats=0 — no snapshot
+    I/O; launch_deadline_s=0 — no watchdog thread; result_ttl_s=0 and a
+    large max_results — no eviction in any test-sized run; chaos only
+    from SHADOW_TPU_SERVE_CHAOS), so the default-configured hot path is
+    byte-for-byte the PR 16 beat loop.
     """
 
     def __init__(self, *, max_lanes: int = 8,
                  pack_deadline_ms: float = 50.0,
                  max_cached_programs: int = 4, beat_windows: int = 32,
                  metrics=None, queue_file: str | None = None,
-                 fleet_factory=None, clock=time.monotonic):
+                 fleet_factory=None, clock=time.monotonic,
+                 snapshot_beats: int = 0,
+                 snapshot_path: str | None = None,
+                 launch_retries: int = 1,
+                 launch_backoff_s: float = 0.05,
+                 launch_deadline_s: float = 0.0,
+                 result_ttl_s: float = 0.0,
+                 max_results: int = 65536,
+                 degraded_after: int = 3,
+                 diag_dir: str = ".",
+                 chaos=None,
+                 watchdog_exit=None):
         if max_lanes < 1:
             raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
         from shadow_tpu.obs.metrics import ServeMetrics
@@ -219,15 +272,61 @@ class SimService:
         self._stopping = False
         self._thread: threading.Thread | None = None
 
+        # -- failure-domain isolation knobs (docs/17 "Failure semantics")
+        self.snapshot_beats = max(int(snapshot_beats), 0)
+        self.snapshot_path = snapshot_path
+        self.launch_retries = max(int(launch_retries), 0)
+        self.launch_backoff_s = max(float(launch_backoff_s), 0.0)
+        self.result_ttl_s = max(float(result_ttl_s), 0.0)
+        self.max_results = max(int(max_results), 1)
+        self.degraded_after = max(int(degraded_after), 1)
+        self.diag_dir = diag_dir
+        self._done_order: "OrderedDict[str, float]" = OrderedDict()
+        self._fail_streak = 0
+        self._degraded = False
+        self._degraded_cause: str | None = None
+        self._resume: tuple | None = None  # (key, reqs) handed to worker
+
+        if chaos is None:
+            from shadow_tpu.serve import chaos as chaos_mod
+
+            marker_dir = (os.path.dirname(os.path.abspath(snapshot_path))
+                          if snapshot_path else None)
+            chaos = chaos_mod.from_env(marker_dir=marker_dir)
+        if chaos is not None and chaos._on_inject is None:
+            # explicitly-passed injectors count the same as env ones
+            chaos._on_inject = (
+                lambda kind: self.metrics.inc("serve_chaos_injected"))
+        self._chaos = chaos
+
+        self._watchdog = None
+        if float(launch_deadline_s) > 0:
+            from shadow_tpu.runtime.supervisor import Watchdog
+
+            self._watchdog = Watchdog(
+                float(launch_deadline_s), diag_dir=diag_dir,
+                label="shadow_tpu.serve", kind="launchstall",
+                info=lambda: {"plane": "serve",
+                              "launches": self._launches},
+                **({"_exit": watchdog_exit} if watchdog_exit else {}),
+            )
+            # the watchdog covers a BEAT, not the process: idle time
+            # between launches must never fire
+            self._watchdog.disarm()
+
     # -- request plane ---------------------------------------------------
 
     def submit(self, doc: dict) -> dict:
         """Validate, classify, queue. Raises ValueError (HTTP 400) on a
-        bad request, ServiceDraining (503) once draining."""
+        bad request, ServiceDraining/ServiceDegraded (503) otherwise."""
         with self._cond:
             if self._stopping:
                 raise ServiceDraining("service is draining; resubmit "
                                       "to the next instance")
+            if self._degraded:
+                raise ServiceDegraded(
+                    "service is degraded after repeated launch failures"
+                    f" ({self._degraded_cause}); resubmit later")
             seq = self._seq
             self._seq += 1
         rid = f"r{seq:06d}"
@@ -242,12 +341,18 @@ class SimService:
             self._submit_t[rid] = self._clock()
             self.packer.push(key, req)
             self.metrics.set("serve_queue_depth", self.packer.depth())
+            self._evict_results_locked()
             self._cond.notify()
         return {"request_id": rid, "class": str(key)}
 
     def result(self, rid: str) -> dict | None:
         with self._cond:
             rec = self._results.get(rid)
+            if rec is not None and rid in self._done_order:
+                # a record still being polled stays resident: reading
+                # refreshes both its LRU position and its TTL clock
+                self._done_order[rid] = self._clock()
+                self._done_order.move_to_end(rid)
             return dict(rec) if rec is not None else None
 
     def queue_snapshot(self) -> dict:
@@ -261,9 +366,47 @@ class SimService:
             "draining": draining,
         }
 
+    def health(self) -> dict:
+        """/healthz body: {"status": "ok"|"draining"|"degraded"} plus
+        the failure cause while degraded. Only "ok" maps to HTTP 200."""
+        with self._cond:
+            if self._stopping:
+                return {"status": "draining"}
+            if self._degraded:
+                return {"status": "degraded",
+                        "cause": self._degraded_cause,
+                        "fail_streak": self._fail_streak}
+        return {"status": "ok"}
+
+    # -- result retention ------------------------------------------------
+
+    def _note_terminal_locked(self, rid: str) -> None:
+        self._done_order[rid] = self._clock()
+        self._done_order.move_to_end(rid)
+
+    def _evict_results_locked(self) -> None:
+        """Drop the oldest terminal (done/error/timeout) records past
+        `max_results` or `result_ttl_s`. Queued/running records are
+        pinned — they are never in `_done_order`."""
+        now = self._clock()
+        evicted = 0
+        while self._done_order:
+            rid, t = next(iter(self._done_order.items()))
+            over = len(self._done_order) > self.max_results
+            stale = self.result_ttl_s > 0 and now - t >= self.result_ttl_s
+            if not (over or stale):
+                break
+            self._done_order.popitem(last=False)
+            self._results.pop(rid, None)
+            evicted += 1
+        if evicted:
+            self.metrics.inc("serve_results_evicted", evicted)
+
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "SimService":
+        if self._watchdog is not None:
+            self._watchdog.start()
         self._thread = threading.Thread(
             target=self._worker_loop, name="shadow-tpu-serve-worker",
             daemon=True)
@@ -272,13 +415,18 @@ class SimService:
 
     def drain(self) -> dict:
         """Graceful stop: finish the launch in flight, persist the
-        pending queue, report. Idempotent."""
+        pending queue, report. Idempotent. An in-flight batch's snapshot
+        is cleared by its own completion; a snapshot left on disk here
+        belongs to a batch that never finished and will be resumed by
+        the next start's `resume_pending_batch`."""
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
         pending = self.packer.drain_all()
         self.metrics.set("serve_queue_depth", 0)
         if self.queue_file is not None:
@@ -291,17 +439,92 @@ class SimService:
         return {"persisted": len(pending), "queue_file": self.queue_file}
 
     def load_queue(self) -> int:
-        """Re-submit requests persisted by a previous drain."""
+        """Re-submit requests persisted by a previous drain.
+
+        A doc the current version refuses (schema drift, a renamed
+        param) must not vanish: failures are collected, logged, and
+        written to `<queue-file>.rejected` for operator triage."""
         if self.queue_file is None or not os.path.exists(self.queue_file):
             return 0
         with open(self.queue_file) as f:
             doc = json.load(f)
         n = 0
+        rejects = []
         for d in doc.get("pending", []):
-            self.submit(d)
-            n += 1
+            try:
+                self.submit(d)
+                n += 1
+            except Exception as e:  # noqa: BLE001 - one bad doc must not drop the rest
+                rejects.append({"doc": d,
+                                "error": f"{type(e).__name__}: {e}"})
+        if rejects:
+            rej_path = self.queue_file + ".rejected"
+            with open(rej_path, "w") as f:
+                json.dump({"version": 1, "rejected": rejects}, f,
+                          sort_keys=True, indent=1)
+                f.write("\n")
+            print(
+                f"serve: {len(rejects)} persisted request(s) no longer "
+                f"parse; kept in {rej_path}",
+                file=sys.stderr, flush=True,
+            )
         os.remove(self.queue_file)
         return n
+
+    def resume_pending_batch(self) -> int:
+        """Crash recovery: if the snapshot file carries a v7 batch
+        manifest, re-register its requests under their ORIGINAL rids and
+        hand the batch to the worker ahead of packer traffic — `_launch`
+        then reloads the state tree and continues from the snapshotted
+        beat. Returns the number of resumed requests (0 if none)."""
+        path = self.snapshot_path
+        if not path or not os.path.exists(path):
+            return 0
+        from shadow_tpu.utils.checkpoint import read_header_info
+
+        try:
+            serve = read_header_info(path).get("serve")
+        except ValueError as e:
+            print(f"serve: ignoring unreadable snapshot {path!r}: {e}",
+                  file=sys.stderr, flush=True)
+            return 0
+        if not serve:
+            return 0
+        try:
+            reqs = []
+            for rid, seq, d in zip(serve["rids"], serve["seqs"],
+                                   serve["docs"]):
+                req = parse_request(d, rid=str(rid), seq=int(seq))
+                validate_request(req)
+                reqs.append(req)
+            if not reqs:
+                return 0
+            key = request_class(reqs[0])
+        except Exception as e:  # noqa: BLE001 - a stale manifest must not kill startup
+            print(
+                f"serve: snapshot {path!r} manifest no longer parses "
+                f"({type(e).__name__}: {e}); leaving it for triage",
+                file=sys.stderr, flush=True,
+            )
+            return 0
+        now = self._clock()
+        with self._cond:
+            self._seq = max(self._seq, max(r.seq for r in reqs) + 1)
+            for r in reqs:
+                self._results[r.rid] = {
+                    "request_id": r.rid, "status": "queued",
+                    "class": str(key),
+                }
+                self._submit_t[r.rid] = now
+            self._resume = (key, reqs)
+            self._cond.notify()
+        self.metrics.inc("serve_requests", len(reqs))
+        print(
+            f"serve: resuming {len(reqs)} request(s) from snapshot "
+            f"{path!r} (beat {serve.get('beats_done', '?')})",
+            file=sys.stderr, flush=True,
+        )
+        return len(reqs)
 
     # -- launch worker ---------------------------------------------------
 
@@ -309,31 +532,177 @@ class SimService:
         while True:
             with self._cond:
                 key = None
+                reqs = None
                 while not self._stopping:
+                    if self._resume is not None:
+                        key, reqs = self._resume
+                        self._resume = None
+                        break
                     key = self.packer.ready()
                     if key is not None:
                         break
                     self._cond.wait(timeout=self.packer.next_timeout())
                 if self._stopping:
                     return
-                reqs = self.packer.pop(key)
-                self.metrics.set("serve_queue_depth",
-                                 self.packer.depth())
+                if reqs is None:
+                    reqs = self.packer.pop(key)
+                    self.metrics.set("serve_queue_depth",
+                                     self.packer.depth())
             if not reqs:
                 continue
             try:
-                self._launch(key, reqs)
+                self._run_batch(key, reqs)
             except Exception as e:  # noqa: BLE001 - one bad batch must not kill the worker
-                self.metrics.inc("serve_errors", len(reqs))
-                with self._cond:
-                    for r in reqs:
-                        self._results[r.rid] = {
-                            "request_id": r.rid, "status": "error",
-                            "error": f"{type(e).__name__}: {e}",
-                            "class": str(key),
-                        }
+                self._fail_requests(key, reqs, e)
             finally:
                 self.metrics.set("serve_inflight", 0)
+
+    def _run_batch(self, key: ClassKey, reqs: list,
+                   depth: int = 0) -> None:
+        """One supervised batch: retry `_launch` with exponential
+        backoff (each retry resumes from the newest snapshot when
+        enabled), then bisect to isolate poison. Terminal failures land
+        on `_fail_requests`; the worker thread always survives."""
+        attempt = 0
+        while True:
+            try:
+                self._launch(key, reqs)
+            except Exception as e:  # noqa: BLE001 - classified below, never propagated
+                if attempt < self.launch_retries:
+                    attempt += 1
+                    self.metrics.inc("serve_launch_retries")
+                    backoff = self.launch_backoff_s * (2 ** (attempt - 1))
+                    print(
+                        f"serve: launch retry {attempt}/"
+                        f"{self.launch_retries} for class {key} after "
+                        f"{type(e).__name__}: {e} "
+                        f"(backoff {backoff:.2f}s)",
+                        file=sys.stderr, flush=True,
+                    )
+                    if backoff > 0:
+                        time.sleep(backoff)
+                    continue
+                if len(reqs) > 1:
+                    # retries exhausted on a multi-request batch: split
+                    # to isolate the poison request; riders complete on
+                    # their halves. The halves are fresh batches — the
+                    # dead attempt's snapshot no longer matches them.
+                    self.metrics.inc("serve_bisections")
+                    self._clear_snapshot()
+                    mid = len(reqs) // 2
+                    print(
+                        f"serve: bisecting {len(reqs)}-request batch of "
+                        f"class {key} ({type(e).__name__}: {e})",
+                        file=sys.stderr, flush=True,
+                    )
+                    self._run_batch(key, reqs[:mid], depth + 1)
+                    self._run_batch(key, reqs[mid:], depth + 1)
+                else:
+                    self._clear_snapshot()
+                    self._fail_requests(key, reqs, e)
+                return
+            else:
+                with self._cond:
+                    self._fail_streak = 0
+                    if self._degraded:
+                        self._degraded = False
+                        self._degraded_cause = None
+                        self.metrics.set("serve_degraded", 0)
+                return
+
+    def _fail_requests(self, key: ClassKey, reqs: list,
+                       e: Exception) -> None:
+        """Terminal failure: per-rid error records, metrics, and the
+        degraded-mode failure streak."""
+        self.metrics.inc("serve_errors", len(reqs))
+        with self._cond:
+            for r in reqs:
+                self._results[r.rid] = {
+                    "request_id": r.rid, "status": "error",
+                    "error": f"{type(e).__name__}: {e}",
+                    "class": str(key),
+                }
+                self._submit_t.pop(r.rid, None)
+                self._note_terminal_locked(r.rid)
+            self._evict_results_locked()
+            self._fail_streak += 1
+            if (self._fail_streak >= self.degraded_after
+                    and not self._degraded):
+                self._degraded = True
+                self._degraded_cause = f"{type(e).__name__}: {e}"
+                self.metrics.set("serve_degraded", 1)
+                print(
+                    f"serve: DEGRADED after {self._fail_streak} "
+                    f"consecutive terminal failures "
+                    f"({self._degraded_cause}); /submit -> 503",
+                    file=sys.stderr, flush=True,
+                )
+
+    # -- snapshots -------------------------------------------------------
+
+    def _snapshot_enabled(self) -> bool:
+        return self.snapshot_beats > 0 and bool(self.snapshot_path)
+
+    def _write_snapshot(self, key: ClassKey, reqs: list, st,
+                        beats_done: int, stops) -> None:
+        from shadow_tpu.utils.checkpoint import save_checkpoint
+
+        manifest = {
+            "version": 1,
+            "class": str(key),
+            "rids": [r.rid for r in reqs],
+            "seqs": [r.seq for r in reqs],
+            "docs": [r.doc() for r in reqs],
+            "beats_done": int(beats_done),
+            "beat_windows": self.beat_windows,
+            "max_lanes": self.max_lanes,
+            "stops": [int(s) for s in stops.tolist()],
+        }
+        save_checkpoint(self.snapshot_path, st,
+                        meta={"plane": "serve"},
+                        serve_manifest=manifest)
+        self.metrics.inc("serve_snapshots")
+
+    def _load_snapshot(self, key: ClassKey, reqs: list, template):
+        """(state, beats_done) from a verified snapshot matching this
+        exact batch, or None. A mismatched or damaged snapshot is
+        ignored (and removed — it can never be resumed by anyone)."""
+        path = self.snapshot_path
+        if not path or not os.path.exists(path):
+            return None
+        from shadow_tpu.utils.checkpoint import (
+            load_checkpoint,
+            read_header_info,
+            verify_checkpoint,
+        )
+
+        try:
+            serve = read_header_info(path).get("serve")
+            if (not serve
+                    or serve.get("class") != str(key)
+                    or serve.get("rids") != [r.rid for r in reqs]
+                    or serve.get("beat_windows") != self.beat_windows
+                    or serve.get("max_lanes") != self.max_lanes):
+                return None
+            verify_checkpoint(path)
+            state, _ = load_checkpoint(path, template)
+        except ValueError as e:
+            print(
+                f"serve: discarding unusable snapshot {path!r}: {e}",
+                file=sys.stderr, flush=True,
+            )
+            self._clear_snapshot()
+            return None
+        return state, int(serve["beats_done"])
+
+    def _clear_snapshot(self) -> None:
+        if self.snapshot_path:
+            try:
+                os.remove(self.snapshot_path)
+            except FileNotFoundError:
+                pass
+
+    # -- launch ----------------------------------------------------------
 
     def _build_entry(self, key: ClassKey, probe: ScenarioRequest):
         """Cold path: compile the class's fleet template at max_lanes.
@@ -415,35 +784,98 @@ class SimService:
         st, binds = fleet.make_inputs(self._batch_plan(key, reqs, L))
         stops = np.asarray([r.stop_ns for r in reqs] + [0] * (L - R),
                            np.int64)
-        # beat loop: beat_windows fixed-window steps per harvest — the
-        # single-fetch heartbeat that streams per-lane progress
-        while True:
-            for _ in range(self.beat_windows):
-                st = fleet.step_window(st, stops, binds=binds)
-            st, bundle = entry.harvest.extract(st, full=False)
-            fetched = entry.harvest.fetch(bundle)
-            sums = entry.harvest.lane_summaries_from(fetched)
-            with self._cond:
-                for i, r in enumerate(reqs):
-                    rec = self._results[r.rid]
-                    rec["progress"] = sums[i]
-            if all(sums[i]["now_ns"] >= r.stop_ns
-                   for i, r in enumerate(reqs)):
-                break
-        # one confirming step: a lane whose last REAL window landed
-        # exactly on its stop has not yet run the done-branch exchange
-        # flush (the fused run's epilogue); this step fires it for every
-        # lane (idempotent for lanes already done) so the harvested
-        # summaries equal the fused solo run's state_summary bit-for-bit
-        st = fleet.step_window(st, stops, binds=binds)
-        _, bundle = entry.harvest.extract(st, full=False)
-        sums = entry.harvest.lane_summaries_from(
-            entry.harvest.fetch(bundle))
+        beats_done = 0
+        resumed_from = None
+        if self._snapshot_enabled():
+            loaded = self._load_snapshot(key, reqs, st)
+            if loaded is not None:
+                st = fleet.adopt_state(loaded[0])
+                beats_done = resumed_from = loaded[1]
+                self.metrics.inc("serve_resumes")
+        # wall deadlines: per-request (deadline_ms from submit time) and
+        # per-beat (the launch watchdog) — both off by default
+        deadline_at = {}
+        with self._cond:
+            for i, r in enumerate(reqs):
+                if r.deadline_ms > 0:
+                    deadline_at[i] = (
+                        self._submit_t.get(r.rid, self._clock())
+                        + r.deadline_ms / 1e3)
+        timed_out: set[int] = set()
+        if self._watchdog is not None:
+            self._watchdog.arm()
+        try:
+            # beat loop: beat_windows fixed-window steps per harvest —
+            # the single-fetch heartbeat that streams per-lane progress
+            while True:
+                beat = beats_done + 1
+                if self._chaos:
+                    self._chaos.fire(
+                        "beat", beat=beat,
+                        seeds=tuple(r.seed for r in reqs))
+                for _ in range(self.beat_windows):
+                    st = fleet.step_window(st, stops, binds=binds)
+                st, bundle = entry.harvest.extract(st, full=False)
+                if self._chaos:
+                    self._chaos.fire("fetch", beat=beat)
+                fetched = entry.harvest.fetch(bundle)
+                sums = entry.harvest.lane_summaries_from(fetched)
+                beats_done = beat
+                if self._watchdog is not None:
+                    self._watchdog.pet(beat=beats_done,
+                                       launch=launch_no)
+                with self._cond:
+                    for i, r in enumerate(reqs):
+                        rec = self._results[r.rid]
+                        rec["progress"] = sums[i]
+                if deadline_at:
+                    now = self._clock()
+                    for i, r in enumerate(reqs):
+                        if (i not in timed_out
+                                and sums[i]["now_ns"] < r.stop_ns
+                                and i in deadline_at
+                                and now >= deadline_at[i]):
+                            timed_out.add(i)
+                if all(i in timed_out or sums[i]["now_ns"] >= r.stop_ns
+                       for i, r in enumerate(reqs)):
+                    break
+                if (self._snapshot_enabled()
+                        and beats_done % self.snapshot_beats == 0):
+                    self._write_snapshot(key, reqs, st, beats_done,
+                                         stops)
+            # one confirming step: a lane whose last REAL window landed
+            # exactly on its stop has not yet run the done-branch
+            # exchange flush (the fused run's epilogue); this step fires
+            # it for every lane (idempotent for lanes already done) so
+            # the harvested summaries equal the fused solo run's
+            # state_summary bit-for-bit
+            st = fleet.step_window(st, stops, binds=binds)
+            _, bundle = entry.harvest.extract(st, full=False)
+            sums = entry.harvest.lane_summaries_from(
+                entry.harvest.fetch(bundle))
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.disarm()
         done_t = self._clock()
+        n_done = 0
         with self._cond:
             for i, r in enumerate(reqs):
                 wall_s = done_t - self._submit_t.pop(r.rid, done_t)
-                self._results[r.rid] = {
+                if i in timed_out:
+                    self._results[r.rid] = {
+                        "request_id": r.rid, "status": "timeout",
+                        "partial_summary": sums[i],
+                        "deadline_ms": r.deadline_ms,
+                        "model": r.model, "seed": r.seed,
+                        "stop_ns": r.stop_ns, "class": str(key),
+                        "lane": i, "lanes_packed": R,
+                        "launch": launch_no,
+                        "wall_ms": round(wall_s * 1e3, 3),
+                    }
+                    self._note_terminal_locked(r.rid)
+                    continue
+                n_done += 1
+                rec = {
                     "request_id": r.rid, "status": "done",
                     "summary": sums[i],
                     "model": r.model, "seed": r.seed,
@@ -452,5 +884,15 @@ class SimService:
                     "cache_hit": cache_hit,
                     "wall_ms": round(wall_s * 1e3, 3),
                 }
+                if resumed_from is not None:
+                    rec["resumed_from_beat"] = resumed_from
+                    rec["beats"] = beats_done
+                self._results[r.rid] = rec
+                self._note_terminal_locked(r.rid)
                 self.metrics.observe_latency_ns(int(wall_s * 1e9))
-        self.metrics.inc("serve_results", R)
+            self._evict_results_locked()
+        if timed_out:
+            self.metrics.inc("serve_timeouts", len(timed_out))
+        self.metrics.inc("serve_results", n_done)
+        if self._snapshot_enabled():
+            self._clear_snapshot()

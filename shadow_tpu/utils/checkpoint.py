@@ -58,8 +58,15 @@ import numpy as np
 # shard-set members. Leaves are unchanged — they were already host-major
 # global arrays — so v3/v4/v5 files still load; they just carry no mesh
 # info and are treated as mesh-unconstrained on resume.
-FORMAT_VERSION = 6
-_LOADABLE_VERSIONS = (3, 4, 5, 6)
+# v7: optional `serve` header section — the serving plane's beat-
+# boundary lane snapshot manifest (docs/17-Serving.md "Failure
+# semantics"): the packed batch's request ids/docs, the class string,
+# and the beat progress, enough for a restarted `shadow_tpu serve` to
+# rebuild the batch's binds deterministically and resume the [L, ...]
+# fleet state tree mid-launch. Leaves are unchanged; v3-v6 files load
+# as before and simply carry no serve section.
+FORMAT_VERSION = 7
+_LOADABLE_VERSIONS = (3, 4, 5, 6, 7)
 
 # Bounded retry for transient IO failure during the atomic write:
 # EINTR (a signal landing mid-fsync — the supervisor's SIGUSR1
@@ -198,7 +205,8 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None,
                     keep: int = 1,
                     extra: dict[str, np.ndarray] | None = None,
                     mesh_info: dict | None = None,
-                    shard: tuple[int, int] | None = None) -> None:
+                    shard: tuple[int, int] | None = None,
+                    serve_manifest: dict | None = None) -> None:
     """Write `state` (any pytree of arrays) to `path` as .npz.
 
     `keep > 1` rotates: the previous `path` becomes `path.1` (and so on
@@ -217,6 +225,11 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None,
     order)}. `shard=(i, n)` writes one member of a sharded set to
     `shard_member_path(path, i, n)` instead of `path` (no rotation —
     set atomicity is all-or-none at resume, not per member).
+
+    `serve_manifest` (v7) records a serving-plane batch manifest (rids,
+    request docs, class string, beat progress) so a restarted serve
+    process can rebuild the packed batch and resume the snapshotted
+    fleet state mid-launch (docs/17-Serving.md "Failure semantics").
     """
     leaves, _ = jax.tree_util.tree_flatten(state)
     leaves = [np.asarray(x) for x in jax.device_get(leaves)]  # shadowlint: no-deadline=checkpoint save; the CLI pets its watchdog at this site
@@ -235,6 +248,8 @@ def save_checkpoint(path: str, state: Any, meta: dict | None = None,
     }
     if mesh_info is not None:
         header["mesh"] = dict(mesh_info)
+    if serve_manifest is not None:
+        header["serve"] = dict(serve_manifest)
     if shard is not None:
         i, n = shard
         if not (0 <= i < n):
@@ -324,8 +339,9 @@ def read_extra(path: str) -> dict[str, np.ndarray]:
 
 def read_header_info(path: str) -> dict:
     """Light header read (no leaf data): {"format_version", "meta",
-    "mesh" (None for pre-v6), "xchg_empty", "shard"}. Raises the same
-    ValueError as `_read_raw` on container damage."""
+    "mesh" (None for pre-v6), "xchg_empty", "shard", "serve" (None for
+    pre-v7 / non-serve files)}. Raises the same ValueError as
+    `_read_raw` on container damage."""
     try:
         with np.load(path) as data:
             header = json.loads(bytes(data["__header__"]).decode("utf-8"))
@@ -341,6 +357,7 @@ def read_header_info(path: str) -> dict:
         "mesh": header.get("mesh"),
         "xchg_empty": header.get("xchg_empty", True),
         "shard": header.get("shard"),
+        "serve": header.get("serve"),
     }
 
 

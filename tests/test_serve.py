@@ -60,15 +60,18 @@ def _req(doc, seq=0):
     return parse_request(doc, rid=f"r{seq:06d}", seq=seq)
 
 
-def _wait_done(svc, rids, timeout_s=560.0):
+_TERMINAL = ("done", "error", "timeout")
+
+
+def _wait_done(svc, rids, timeout_s=560.0, poll_s=0.2):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         recs = {r: svc.result(r) for r in rids}
-        if all(x["status"] in ("done", "error") for x in recs.values()):
+        if all(x["status"] in _TERMINAL for x in recs.values()):
             return recs
-        time.sleep(0.2)
+        time.sleep(poll_s)
     raise TimeoutError(f"requests still pending: "
-                       f"{[r for r in rids if svc.result(r)['status'] not in ('done', 'error')]}")
+                       f"{[r for r in rids if svc.result(r)['status'] not in _TERMINAL]}")
 
 
 # --------------------------------------------------------- request schema
@@ -349,6 +352,437 @@ def test_diff_runs_served_vs_solo(tmp_path):
         D.load_artifact(str(a))
 
 
+# ------------------------------------- failure semantics (ISSUE 17, no jit)
+#
+# A deterministic pure-python Fleet/Harvest pair drives the supervised
+# beat loop without compiling anything: each active lane advances
+# window_ns of sim time per step_window and bumps counters from its
+# seed, so two services over the same requests produce bit-identical
+# summaries — which is exactly what the snapshot-resume and bisection
+# pins need to assert.
+
+
+class _FakeFleet:
+    def __init__(self, lanes, window_ns=50_000_000):
+        self.lanes = int(lanes)
+        self.window_ns = int(window_ns)
+
+    def make_inputs(self, plan):
+        import numpy as np
+
+        L = plan.lanes
+        st = {
+            "now_ns": np.zeros(L, np.int64),
+            "windows": np.zeros(L, np.int64),
+            "executed": np.zeros(L, np.int64),
+            "sweeps": np.zeros(L, np.int64),
+            "queue_drops": np.zeros(L, np.int64),
+            "seeds": np.asarray(plan.seeds, np.int64),
+        }
+        return st, np.asarray(plan.seeds, np.int64)
+
+    def step_window(self, st, stops, binds=None):
+        import numpy as np
+
+        new = {k: v.copy() for k, v in st.items()}
+        stops = np.asarray(stops)
+        for i in range(self.lanes):
+            if int(stops[i]) > 0 and int(new["now_ns"][i]) < int(stops[i]):
+                new["now_ns"][i] = min(
+                    int(new["now_ns"][i]) + self.window_ns, int(stops[i]))
+                new["windows"][i] += 1
+                new["executed"][i] += int(new["seeds"][i]) % 5 + 1
+        return new
+
+    def adopt_state(self, state):
+        import numpy as np
+
+        return {k: np.asarray(v) for k, v in state.items()}
+
+
+class _FakeHarvest:
+    def extract(self, st, full=False):
+        return st, {k: v.copy() for k, v in st.items()}
+
+    def fetch(self, bundle):
+        return bundle
+
+    def lane_summaries_from(self, fetched):
+        keys = ("now_ns", "windows", "executed", "sweeps", "queue_drops")
+        return [{k: int(fetched[k][i]) for k in keys}
+                for i in range(len(fetched["now_ns"]))]
+
+
+def _fake_entry_factory(lanes, window_ns=50_000_000, broken=None):
+    from shadow_tpu.serve.service import CacheEntry
+
+    def factory(key, probe):
+        if broken is not None and broken[0]:
+            raise RuntimeError("injected factory failure")
+        return CacheEntry(key=key, fleet=_FakeFleet(lanes, window_ns),
+                          harvest=_FakeHarvest(), names=NAMES)
+    return factory
+
+
+def _tot(svc, family):
+    return svc.metrics.totals()[f"shadow_tpu_{family}"]
+
+
+def test_deadline_ms_request_field():
+    r = _req({**_doc(1), "deadline_ms": 250})
+    assert r.deadline_ms == 250
+    assert r.doc()["deadline_ms"] == 250
+    # zero-cost: the default doc shape is unchanged from PR 16
+    assert "deadline_ms" not in _req(_doc(1)).doc()
+    with pytest.raises(ValueError, match="deadline_ms"):
+        _req({**_doc(1), "deadline_ms": -1})
+
+
+def test_checkpoint_v7_serve_manifest_roundtrip(tmp_path):
+    import numpy as np
+
+    from shadow_tpu.utils import checkpoint as C
+
+    state = {"a": np.arange(4, dtype=np.int64)}
+    man = {"version": 1, "rids": ["r000001"], "beats_done": 3,
+           "class": "phold(...)/faults:none"}
+    p = str(tmp_path / "snap.npz")
+    C.save_checkpoint(p, state, meta={"plane": "serve"},
+                      serve_manifest=man)
+    info = C.read_header_info(p)
+    assert info["format_version"] == C.FORMAT_VERSION == 7
+    assert info["serve"] == man
+    assert C.verify_checkpoint(p) == {"plane": "serve"}
+    loaded, meta = C.load_checkpoint(p, {"a": np.zeros(4, np.int64)})
+    assert list(loaded["a"]) == [0, 1, 2, 3]
+    # a checkpoint without a manifest reads back serve=None
+    C.save_checkpoint(p, state)
+    assert C.read_header_info(p)["serve"] is None
+
+
+def test_serve_chaos_parse_and_one_shot(tmp_path):
+    from shadow_tpu.serve.chaos import ChaosInjected, ServeChaos
+
+    with pytest.raises(ValueError, match="unknown injector"):
+        ServeChaos("explode:beat=1")
+    with pytest.raises(ValueError, match="needs secs="):
+        ServeChaos("wedge:beat=1")
+    with pytest.raises(ValueError, match="non-numeric"):
+        ServeChaos("raise:beat=x")
+    assert not ServeChaos("")  # empty spec: completely inert
+
+    fired = []
+    c = ServeChaos("raise:beat=2", on_inject=fired.append)
+    c.fire("beat", beat=1, seeds=(1,))  # wrong beat: silent
+    with pytest.raises(ChaosInjected):
+        c.fire("beat", beat=2, seeds=(1,))
+    c.fire("beat", beat=2, seeds=(1,))  # one-shot: already fired
+    assert fired == ["raise"]
+
+    # marker-dir one-shots survive a process restart (fresh instance)
+    d = str(tmp_path)
+    c1 = ServeChaos("raise:beat=1", marker_dir=d)
+    with pytest.raises(ChaosInjected):
+        c1.fire("beat", beat=1)
+    assert list(tmp_path.glob("serve_chaos.raise.*.fired"))
+    c2 = ServeChaos("raise:beat=1", marker_dir=d)  # "the relaunch"
+    c2.fire("beat", beat=1)  # marker says already fired
+
+    # poison is persistent — it must fire on every bisection attempt
+    p = ServeChaos("poison:seed=13")
+    for _ in range(2):
+        with pytest.raises(ChaosInjected):
+            p.fire("beat", beat=1, seeds=(11, 13))
+    p.fire("beat", beat=1, seeds=(11, 12))  # absent seed: silent
+
+
+def test_error_path_records_metrics_worker_alive():
+    """Satellite pin: a raising factory yields per-rid error records,
+    increments serve_errors, leaves the worker alive for the next
+    batch, keeps /healthz accurate — and no longer leaks _submit_t."""
+    broken = [True]
+    svc = SimService(max_lanes=2, pack_deadline_ms=30.0, beat_windows=2,
+                     fleet_factory=_fake_entry_factory(2, broken=broken),
+                     launch_retries=0, launch_backoff_s=0.0,
+                     degraded_after=99).start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"] for s in (1, 2)]
+        recs = _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+        assert all(r["status"] == "error" for r in recs.values())
+        assert all("injected factory failure" in r["error"]
+                   for r in recs.values())
+        assert svc.health() == {"status": "ok"}
+        # the worker survives: a second batch gets its own records
+        rids2 = [svc.submit(_doc(s))["request_id"] for s in (3, 4)]
+        recs2 = _wait_done(svc, rids2, timeout_s=60, poll_s=0.05)
+        assert all(r["status"] == "error" for r in recs2.values())
+        assert _tot(svc, "serve_errors") == 4
+        assert svc._submit_t == {}  # the leak fix
+    finally:
+        svc.drain()
+
+
+def test_degraded_flip_blocks_submit_and_recovers():
+    from shadow_tpu.serve.service import ServiceDegraded
+
+    broken = [True]
+    svc = _quiet_service(
+        fleet_factory=_fake_entry_factory(64, broken=broken),
+        launch_retries=0, launch_backoff_s=0.0, degraded_after=2)
+    reqs = [_req(_doc(s), seq=s) for s in (1, 2, 3)]
+    key = request_class(reqs[0])
+
+    svc._run_batch(key, [reqs[0]])
+    assert svc.health() == {"status": "ok"}
+    svc._run_batch(key, [reqs[1]])
+    h = svc.health()
+    assert h["status"] == "degraded"
+    assert "injected factory failure" in h["cause"]
+    assert _tot(svc, "serve_degraded") == 1
+    with pytest.raises(ServiceDegraded):
+        svc.submit(_doc(9))
+
+    # one successful launch recovers the service
+    broken[0] = False
+    svc._run_batch(key, [reqs[2]])
+    assert svc.health() == {"status": "ok"}
+    assert _tot(svc, "serve_degraded") == 0
+    assert svc.result(reqs[2].rid)["status"] == "done"
+    assert svc.submit(_doc(9))["request_id"]
+
+
+def test_retry_resumes_from_snapshot_bit_identical(tmp_path):
+    import os
+
+    from shadow_tpu.serve.chaos import ServeChaos
+
+    kw = dict(max_lanes=4, pack_deadline_ms=30.0, beat_windows=2,
+              launch_backoff_s=0.0)
+    docs = [_doc(s) for s in (11, 12, 13, 14)]
+
+    # reference: the same requests through an unmolested service
+    ref = SimService(fleet_factory=_fake_entry_factory(4), **kw).start()
+    try:
+        ref_rids = [ref.submit(d)["request_id"] for d in docs]
+        ref_recs = _wait_done(ref, ref_rids, timeout_s=60, poll_s=0.05)
+    finally:
+        ref.drain()
+
+    snap = str(tmp_path / "snap.npz")
+    svc = SimService(fleet_factory=_fake_entry_factory(4),
+                     snapshot_beats=2, snapshot_path=snap,
+                     launch_retries=1,
+                     chaos=ServeChaos("raise:beat=3"), **kw).start()
+    try:
+        rids = [svc.submit(d)["request_id"] for d in docs]
+        recs = _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+
+    assert _tot(svc, "serve_chaos_injected") == 1
+    assert _tot(svc, "serve_launch_retries") == 1
+    assert _tot(svc, "serve_snapshots") >= 1
+    assert _tot(svc, "serve_resumes") == 1
+    assert _tot(svc, "serve_bisections") == 0
+    for rid, ref_rid in zip(rids, ref_rids):
+        rec = recs[rid]
+        assert rec["status"] == "done", rec
+        # bit-identical to the uninterrupted run...
+        assert rec["summary"] == ref_recs[ref_rid]["summary"]
+        # ...and genuinely resumed: windows re-executed < completed
+        assert rec["resumed_from_beat"] == 2
+        assert rec["resumed_from_beat"] < rec["beats"]
+    assert not os.path.exists(snap)  # consumed on completion
+
+
+def test_bisection_isolates_poison_request():
+    from shadow_tpu.serve.chaos import ServeChaos
+
+    svc = SimService(max_lanes=4, pack_deadline_ms=30.0, beat_windows=2,
+                     fleet_factory=_fake_entry_factory(4),
+                     launch_retries=0, launch_backoff_s=0.0,
+                     chaos=ServeChaos("poison:seed=13")).start()
+    try:
+        rids = {s: svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)}
+        recs = _wait_done(svc, list(rids.values()), timeout_s=60,
+                          poll_s=0.05)
+    finally:
+        svc.drain()
+
+    # the poison request alone errors; every rider completes
+    assert recs[rids[13]]["status"] == "error"
+    assert "poison seed 13" in recs[rids[13]]["error"]
+    for s in (11, 12, 14):
+        assert recs[rids[s]]["status"] == "done", recs[rids[s]]
+    # [11,12,13,14] -> [11,12] + [13,14] -> [13] + [14]
+    assert _tot(svc, "serve_bisections") == 2
+    assert _tot(svc, "serve_errors") == 1
+    assert svc._submit_t == {}
+
+
+def test_per_request_deadline_timeout_partial_summary():
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.05
+        return t[0]
+
+    svc = SimService(max_lanes=2, pack_deadline_ms=1.0, beat_windows=2,
+                     fleet_factory=_fake_entry_factory(2),
+                     clock=clock).start()
+    try:
+        fast = svc.submit(_doc(1, stop_s=0.5))["request_id"]
+        slow = svc.submit({**_doc(2, stop_s=50.0),
+                           "deadline_ms": 200})["request_id"]
+        recs = _wait_done(svc, [fast, slow], timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+
+    assert recs[fast]["status"] == "done"
+    rec = recs[slow]
+    assert rec["status"] == "timeout"
+    assert rec["deadline_ms"] == 200
+    # the last harvested partial progress rides the record
+    assert 0 < rec["partial_summary"]["now_ns"] < 50 * 10**9
+    assert _tot(svc, "serve_timeouts") == 1
+    assert svc._submit_t == {}
+
+
+def test_launch_watchdog_fires_with_diag_bundle(tmp_path):
+    from shadow_tpu.runtime.supervisor import EXIT_STALL
+    from shadow_tpu.serve.chaos import ServeChaos
+
+    exits = []
+    svc = SimService(max_lanes=1, pack_deadline_ms=30.0, beat_windows=2,
+                     fleet_factory=_fake_entry_factory(1),
+                     launch_retries=0, launch_backoff_s=0.0,
+                     launch_deadline_s=0.3, diag_dir=str(tmp_path),
+                     chaos=ServeChaos("wedge:beat=2,secs=1.5"),
+                     watchdog_exit=exits.append).start()
+    try:
+        rid = svc.submit(_doc(3))["request_id"]
+        recs = _wait_done(svc, [rid], timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+
+    # the wedged fetch blew the per-beat deadline: the watchdog fired
+    # with the retryable stall exit and a diagnostic bundle naming the
+    # last good beat (the injected exit keeps the test process alive;
+    # the real process dies and the --retry loop resumes the batch)
+    assert exits == [EXIT_STALL]
+    bundles = list(tmp_path.glob("shadow_tpu.serve.launchstall.*.json"))
+    assert len(bundles) == 1
+    payload = json.loads(bundles[0].read_text())
+    assert payload["exit_code"] == EXIT_STALL
+    assert payload["progress"]["beat"] == 1
+    assert list(tmp_path.glob("shadow_tpu.serve.launchstall.*.stacks.txt"))
+    assert recs[rid]["status"] == "done"
+
+
+def test_restart_resumes_pending_batch_bit_identical(tmp_path):
+    import os
+
+    import numpy as np
+
+    kw = dict(max_lanes=2, pack_deadline_ms=30.0, beat_windows=2,
+              snapshot_beats=1)
+    docs = [_doc(21), _doc(22)]
+
+    ref = SimService(fleet_factory=_fake_entry_factory(2),
+                     snapshot_path=str(tmp_path / "ref.npz"),
+                     **kw).start()
+    try:
+        ref_rids = [ref.submit(d)["request_id"] for d in docs]
+        ref_recs = _wait_done(ref, ref_rids, timeout_s=60, poll_s=0.05)
+    finally:
+        ref.drain()
+
+    # "process 1" dies mid-batch: persist exactly what its beat loop
+    # would have written at beat 3, then abandon the service unstarted
+    snap = str(tmp_path / "snap.npz")
+    svc1 = SimService(fleet_factory=_fake_entry_factory(2),
+                      snapshot_path=snap, **kw)
+    reqs = [_req(d, seq=i) for i, d in enumerate(docs)]
+    key = request_class(reqs[0])
+    entry = _fake_entry_factory(2)(key, reqs[0])
+    st, binds = entry.fleet.make_inputs(svc1._batch_plan(key, reqs, 2))
+    stops = np.asarray([r.stop_ns for r in reqs], np.int64)
+    for _ in range(3 * kw["beat_windows"]):
+        st = entry.fleet.step_window(st, stops, binds=binds)
+    svc1._write_snapshot(key, reqs, st, 3, stops)
+    assert os.path.exists(snap)
+
+    # "process 2" resumes the batch under the ORIGINAL request ids
+    svc2 = SimService(fleet_factory=_fake_entry_factory(2),
+                      snapshot_path=snap, **kw)
+    assert svc2.resume_pending_batch() == 2
+    assert svc2.result("r000000")["status"] == "queued"
+    svc2.start()
+    recs = _wait_done(svc2, ["r000000", "r000001"], timeout_s=60,
+                      poll_s=0.05)
+    assert _tot(svc2, "serve_resumes") == 1
+    for rid, ref_rid in zip(["r000000", "r000001"], ref_rids):
+        assert recs[rid]["status"] == "done"
+        assert recs[rid]["resumed_from_beat"] == 3
+        assert recs[rid]["summary"] == ref_recs[ref_rid]["summary"]
+    assert not os.path.exists(snap)
+    # new submissions sequence PAST the resumed ids — no rid collision
+    assert svc2.submit(_doc(9))["request_id"] == "r000002"
+    svc2.drain()
+
+
+def test_result_retention_lru_cap_and_pinning():
+    svc = _quiet_service(max_results=2)
+    reqs = [_req(_doc(s), seq=s) for s in range(4)]
+    key = request_class(reqs[0])
+    for r in reqs[:3]:
+        svc._fail_requests(key, [r], RuntimeError("x"))
+    # cap 2: the oldest terminal record evicted, newer ones resident
+    assert svc.result("r000000") is None
+    assert svc.result("r000001")["status"] == "error"
+    assert _tot(svc, "serve_results_evicted") == 1
+    # reading r000001 refreshed it: the next eviction takes r000002
+    svc._fail_requests(key, [reqs[3]], RuntimeError("x"))
+    assert svc.result("r000002") is None
+    assert svc.result("r000001") is not None
+
+
+def test_result_retention_ttl_spares_queued():
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    svc = _quiet_service(result_ttl_s=5.0, clock=clock)
+    req = _req(_doc(0), seq=999)  # out of the submit rid sequence
+    svc._fail_requests(request_class(req), [req], RuntimeError("x"))
+    # queued records are pinned no matter how stale the clock gets
+    rids = [svc.submit(_doc(i + 1))["request_id"] for i in range(8)]
+    assert svc.result("r000999") is None  # TTL-evicted unread record
+    assert _tot(svc, "serve_results_evicted") == 1
+    assert all(svc.result(r)["status"] == "queued" for r in rids)
+
+
+def test_load_queue_writes_rejects_instead_of_dropping(tmp_path):
+    import os
+
+    qf = str(tmp_path / "q.json")
+    good = _doc(5)
+    bad = {"model": "phold", "params": {"warp": 1}, "stop_s": 1.0}
+    with open(qf, "w") as f:
+        json.dump({"version": 1, "pending": [good, bad]}, f)
+    svc = _quiet_service(queue_file=qf)
+    assert svc.load_queue() == 1
+    assert svc.packer.depth() == 1
+    assert not os.path.exists(qf)
+    rej = json.load(open(qf + ".rejected"))
+    assert len(rej["rejected"]) == 1
+    assert rej["rejected"][0]["doc"] == bad
+    assert "warp" in rej["rejected"][0]["error"]
+
+
 # ----------------------------------------------- end-to-end (compiling)
 
 
@@ -442,3 +876,36 @@ def test_inert_lane_padding_counters_exactly_zero():
     assert int(sums["executed"][0]) > 0
     assert int(sums["now_ns"][0]) == 500_000_000
     assert int(sums["now_ns"][1]) == 375_000_000
+
+
+@pytest.mark.slow  # one fleet compile + 4 solo oracle compiles
+def test_snapshot_resume_real_engine_bit_identical(tmp_path):
+    """ISSUE 17 acceptance pin on the REAL engine: a chaos-injected
+    launch failure retries from the beat snapshot and every request
+    still matches its solo reference bit-for-bit.
+
+    This is the test that catches what the fake-fleet twin above
+    cannot: the resumed state tree goes through checkpoint numpy
+    leaves and back into a DONATING jit. `Fleet.adopt_state` must
+    hand XLA buffers it owns — on the CPU backend a zero-copy
+    `jnp.asarray` aliases the loader's numpy memory, and donating
+    that aliased buffer corrupts the heap and the resumed lanes."""
+    from shadow_tpu.serve.chaos import ServeChaos
+
+    docs = [_doc(s) for s in (901, 902, 903, 904)]
+    svc = SimService(max_lanes=4, pack_deadline_ms=30.0, beat_windows=2,
+                     snapshot_beats=1,
+                     snapshot_path=str(tmp_path / "snap.npz"),
+                     launch_retries=1, launch_backoff_s=0.0,
+                     chaos=ServeChaos("raise:beat=3")).start()
+    try:
+        rids = [svc.submit(d)["request_id"] for d in docs]
+        recs = _wait_done(svc, rids)
+    finally:
+        svc.drain()
+    assert _tot(svc, "serve_resumes") == 1
+    for rid, d in zip(rids, docs):
+        rec = recs[rid]
+        assert rec["status"] == "done", rec
+        assert rec["summary"] == solo_reference(d)
+        assert 0 < rec["resumed_from_beat"] < rec["beats"]
