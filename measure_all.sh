@@ -269,6 +269,140 @@ echo "=== serve_smoke exit=$? $(date +%H:%M:%S)" >> "$S"
 # solo_reference via tools/diff_runs, and the recovered records must
 # show resumed_from_beat < beats (windows re-executed < completed).
 run serve_chaos 900 --serve-chaos JAX_PLATFORMS=cpu BENCH_BUDGET_S=840
+# serve-trace acceptance (docs/18-Serve-Tracing.md): a traced real
+# `shadow_tpu serve` subprocess (--trace-requests + --ledger-file) runs
+# a packed 4-lane class with one chaos-injected retry
+# (SHADOW_TPU_SERVE_CHAOS raise:beat=2, resume from the beat-1
+# snapshot). Four gates: (a) every request's /trace span tree is
+# complete (submit/queue_wait/pack_wait/retry/result + launch beats)
+# and its queue+pack+run+retry decomposition tiles the recorded
+# wall_ms, (b) the flight ledger round-trips through tools/serve_report
+# with the retry/resume accounted, (c) the /metrics scrape carries
+# per-class histogram exemplars and still passes check_openmetrics,
+# (d) the merged tools/export_trace --serve-ledger view is one valid
+# Chrome trace with serve wall (pid 2) + lane sim-time (pid 3) tracks
+# and balanced flow arrows.
+echo "=== serve_trace start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"serve_trace\"}" >> "$R"
+timeout 900 env JAX_PLATFORMS=cpu \
+  SHADOW_TPU_SERVE_CHAOS="raise:beat=2" \
+  python - >> "$R" 2>> "$S" <<'PYEOF'
+import glob, json, os, re, shutil, signal, subprocess, sys, time
+import urllib.request
+
+from shadow_tpu.obs.servetrace import decompose, load_ledger
+from shadow_tpu.tools.serve_client import request_docs, run_load
+from shadow_tpu.tools.serve_report import reduce_ledger
+
+LEDGER = "measure_serve_ledger.jsonl"
+SNAP = "measure_serve_trace.snapshot.npz"
+QF = "measure_serve_trace_queue.json"
+DIR = "measure_served_trace"
+shutil.rmtree(DIR, ignore_errors=True)
+for p in [LEDGER, SNAP, QF] + glob.glob("serve_chaos.*.fired"):
+    os.path.exists(p) and os.remove(p)
+
+srv = subprocess.Popen(
+    [sys.executable, "-m", "shadow_tpu", "serve", "--port", "0",
+     "--max-lanes", "4", "--pack-deadline-ms", "600000",
+     "--beat-windows", "2", "--snapshot-beats", "1",
+     "--snapshot-path", SNAP, "--launch-retries", "1",
+     "--queue-file", QF, "--trace-requests", "1024",
+     "--ledger-file", LEDGER],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+port = None
+t0 = time.monotonic()
+for line in srv.stderr:
+    m = re.search(r"listening http://[^:]+:(\d+)/", line)
+    if m:
+        port = int(m.group(1))
+        break
+    if time.monotonic() - t0 > 120:
+        break
+assert port, "server never printed its listening line"
+url = f"http://127.0.0.1:{port}"
+
+docs = request_docs(4, mix="plain", hosts=8, stop_s=0.5)
+report = run_load(url, docs, out_dir=DIR, timeout_s=600)
+assert report["errors"] == 0, report
+assert report.get("traced") == 4, report
+
+# gate (a): span-tree completeness + the wall-time tiling acceptance
+slack_ms = 50.0
+for i in range(4):
+    rid = f"r{i:06d}"
+    with open(os.path.join(DIR, f"{rid}.trace.json")) as f:
+        tree = json.load(f)
+    names = [s["name"] for s in tree["spans"]]
+    for required in ("submit", "queue_wait", "pack_wait", "retry",
+                     "result"):
+        assert required in names, (rid, required, names)
+    launch_names = {s["name"] for ln in tree["launches"]
+                    for s in ln["spans"]}
+    assert {"cache", "pack", "beat", "confirm"} <= launch_names
+    assert any(s["name"] == "resume" for ln in tree["launches"]
+               for s in ln["spans"]), rid
+    d = decompose(tree)
+    assert d["status"] == "done" and d["total_ms"], (rid, d)
+    accounted = (d["queue_wait_ms"] + d["pack_wait_ms"] + d["run_ms"]
+                 + d["retry_ms"])
+    assert accounted <= d["total_ms"] + slack_ms, (rid, d)
+    assert accounted >= 0.5 * d["total_ms"] - slack_ms, (rid, d)
+
+# gate (c): per-class exemplars in a valid scrape
+scrape = urllib.request.urlopen(f"{url}/metrics", timeout=10).read()
+with open("measure_serve_trace.metrics", "wb") as f:
+    f.write(scrape)
+chk = subprocess.run(
+    [sys.executable, "-m", "shadow_tpu.tools.check_openmetrics",
+     "measure_serve_trace.metrics"], capture_output=True, text=True)
+assert chk.returncode == 0, chk.stdout
+for fam in ("shadow_tpu_serve_queue_wait_ns_bucket",
+            "shadow_tpu_serve_pack_wait_ns_bucket",
+            "shadow_tpu_serve_beat_wall_ns_bucket"):
+    assert fam.encode() in scrape, f"missing per-class family {fam}"
+assert b" # {trace_id=" in scrape, "no exemplars rendered"
+
+srv.send_signal(signal.SIGTERM)
+rc = srv.wait(timeout=120)
+assert rc == 0, f"drain exit code {rc} != 0"
+
+# gate (b): ledger -> serve_report round-trip, retry/resume accounted
+rep = subprocess.run(
+    [sys.executable, "-m", "shadow_tpu.tools.serve_report", LEDGER],
+    capture_output=True, text=True)
+assert rep.returncode == 0, rep.stderr
+cli_report = json.loads(rep.stdout)
+header, records = load_ledger(LEDGER)
+assert reduce_ledger(header, records) == cli_report
+assert cli_report["requests"] == 4, cli_report
+assert cli_report["retries"] == 1, cli_report
+assert cli_report["chaos_injections"] == 1, cli_report
+assert cli_report["pack_efficiency"] == 1.0, cli_report
+
+# gate (d): the merged Chrome-trace view loads and is flow-balanced
+from shadow_tpu.tools.export_trace import export
+stats = export(None, "measure_serve_trace.json", ledger_path=LEDGER)
+with open("measure_serve_trace.json") as f:
+    doc = json.load(f)
+evs = doc["traceEvents"]
+assert {e["ph"] for e in evs} <= {"M", "i", "s", "f", "X"}
+assert {2, 3} <= {e["pid"] for e in evs}
+starts = [e for e in evs if e["ph"] == "s"]
+ends = [e for e in evs if e["ph"] == "f"]
+assert len(starts) == len(ends) > 0
+
+print(json.dumps({
+    "serve_trace_requests": 4, "serve_trace_tiled": True,
+    "serve_trace_retries": cli_report["retries"],
+    "serve_trace_ledger_records": len(records),
+    "serve_trace_openmetrics": chk.stderr.strip(),
+    "serve_trace_merged_events": stats["events"],
+    "serve_trace_flows": stats["flows"],
+    "serve_trace_drain_exit": rc,
+}))
+PYEOF
+echo "=== serve_trace exit=$? $(date +%H:%M:%S)" >> "$S"
 # perf smoke: a small CPU-backend PHOLD, a small tgen TCP workload
 # under the frontier drain, and an 8-lane PHOLD fleet, each against its
 # checked-in PERF_FLOOR.json floor — fails (exit 1) when any of the

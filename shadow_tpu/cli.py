@@ -206,6 +206,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="serve mode: consecutive terminal launch "
                         "failures before /healthz reports degraded and "
                         "/submit returns 503 (a later success recovers)")
+    p.add_argument("--trace-requests", type=int, default=0, metavar="N",
+                   help="serve mode: record request-scoped spans for "
+                        "the most recent N requests and serve each span "
+                        "tree at GET /trace/<id> (0 = tracing off; "
+                        "docs/18-Serve-Tracing.md)")
+    p.add_argument("--ledger-file", default=None, metavar="JSONL",
+                   help="serve mode: append every trace span/event to "
+                        "this JSONL flight ledger (implies tracing; "
+                        "flushed per record, so tools/serve_report and "
+                        "the merged tools/export_trace view work on "
+                        "dead servers)")
     p.add_argument("--checkpoint-interval", type=float, default=0.0,
                    help="write a checkpoint every N sim seconds (0=off). "
                         "Independent of the interval, SIGINT/SIGTERM "
@@ -577,6 +588,16 @@ def _run_serve(args) -> int:
     from shadow_tpu.serve.http import ServeServer
     from shadow_tpu.serve.service import SimService
 
+    tracer = None
+    if args.trace_requests > 0 or args.ledger_file:
+        from shadow_tpu.obs.servetrace import ServeTracer
+
+        tracer = ServeTracer(
+            max_requests=args.trace_requests or 4096,
+            ledger_file=args.ledger_file,
+            ledger_meta={"max_lanes": args.max_lanes,
+                         "beat_windows": args.beat_windows},
+        )
     svc = SimService(
         max_lanes=args.max_lanes,
         pack_deadline_ms=args.pack_deadline_ms,
@@ -591,6 +612,7 @@ def _run_serve(args) -> int:
         max_results=args.max_results,
         degraded_after=args.degraded_after,
         diag_dir=args.diag_dir,
+        tracer=tracer,
     )
     with Supervisor(label="shadow_tpu-serve") as sup:
         # resume BEFORE reloading the drained queue: the crashed batch
@@ -612,6 +634,8 @@ def _run_serve(args) -> int:
             print(f"serve: drained — {report['persisted']} pending "
                   f"request(s) persisted to {report['queue_file']}",
                   file=sys.stderr, flush=True)
+            if tracer is not None:
+                tracer.close()
             sup.mark_drained()
     return sup.exit_code()
 

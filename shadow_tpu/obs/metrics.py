@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import re
 import threading
 import time
 from typing import Any, Iterable
@@ -513,6 +514,25 @@ SERVE_SPECS: tuple[MetricSpec, ...] = (
 
 _SERVE_HIST = _P + "serve_request_latency_ns"
 
+# per-class latency-decomposition histograms (docs/18-Serve-Tracing.md):
+# short family key (what ServeTracer feeds via `observe_class`) ->
+# (full family name, HELP). Same log2 bucket scheme as the request
+# latency histogram; rendered only once a class has observations, so a
+# tracer-off exposition is byte-identical to the pre-tracing one.
+_SERVE_CLASS_HISTS: tuple[tuple[str, str, str], ...] = (
+    ("queue_wait", _P + "serve_queue_wait_ns",
+     "Submit->launch queue wait per class, wall nanoseconds."),
+    ("pack_wait", _P + "serve_pack_wait_ns",
+     "Launch setup (cache/pack/bind) wait per class, wall "
+     "nanoseconds."),
+    ("beat_wall", _P + "serve_beat_wall_ns",
+     "Wall time per harvest beat per class, nanoseconds."),
+)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
 
 class ServeMetrics:
     """Thread-safe serve-plane registry: the SERVE_SPECS counters and
@@ -531,6 +551,10 @@ class ServeMetrics:
         self._v: dict[str, float] = {s.name: 0 for s in SERVE_SPECS}
         self._lat_buckets = [0] * NB
         self._lat_sum = 0
+        # (short family, class) -> {"b": [NB counts], "sum": ns,
+        #   "ex": {bucket idx: (rid, ns, t_s)}} — the exemplar is the
+        # WORST (max ns) request id seen in that bucket
+        self._class_h: dict[tuple[str, str], dict] = {}
 
     def inc(self, family: str, n: float = 1) -> None:
         with self._lock:
@@ -552,12 +576,43 @@ class ServeMetrics:
             self._lat_buckets[idx] += 1
             self._lat_sum += max(ns, 0)
 
+    def observe_class(self, family: str, cls: str, ns: int, *,
+                      rid: str | None = None,
+                      t_s: float | None = None) -> None:
+        """Fold one wait/beat duration into the per-class histogram
+        `family` ("queue_wait" | "pack_wait" | "beat_wall"). `rid`
+        becomes the bucket's OpenMetrics exemplar when it is the worst
+        observation landed there so far; `t_s` is its exemplar
+        timestamp (tracer-clock seconds). Fed by `ServeTracer` — a
+        tracer-off service never calls this, keeping `render()`
+        byte-identical."""
+        from shadow_tpu.obs.stats import NB
+
+        if family not in {k for k, _, _ in _SERVE_CLASS_HISTS}:
+            raise ValueError(
+                f"unknown per-class histogram family {family!r}")
+        ns = int(ns)
+        idx = 0 if ns <= 0 else min(ns.bit_length(), NB - 1)
+        with self._lock:
+            h = self._class_h.setdefault(
+                (family, str(cls)), {"b": [0] * NB, "sum": 0, "ex": {}})
+            h["b"][idx] += 1
+            h["sum"] += max(ns, 0)
+            ex = h["ex"].get(idx)
+            if rid is not None and (ex is None or ns >= ex[1]):
+                h["ex"][idx] = (rid, ns, t_s)
+
     def totals(self) -> dict:
         with self._lock:
             out = {k: (int(v) if float(v).is_integer() else v)
                    for k, v in sorted(self._v.items())}
             out[f"{_SERVE_HIST}_count"] = sum(self._lat_buckets)
             out[f"{_SERVE_HIST}_sum"] = self._lat_sum
+            for (fam, cls), h in sorted(self._class_h.items()):
+                full = next(f for k, f, _ in _SERVE_CLASS_HISTS
+                            if k == fam)
+                out[f'{full}_count{{class="{cls}"}}'] = sum(h["b"])
+                out[f'{full}_sum{{class="{cls}"}}'] = h["sum"]
         return out
 
     def render(self) -> str:
@@ -567,6 +622,9 @@ class ServeMetrics:
             values = dict(self._v)
             buckets = list(self._lat_buckets)
             lat_sum = self._lat_sum
+            class_h = {k: {"b": list(h["b"]), "sum": h["sum"],
+                           "ex": dict(h["ex"])}
+                       for k, h in self._class_h.items()}
         lines: list[str] = []
         for spec in SERVE_SPECS:
             lines.append(f"# TYPE {spec.name} {spec.kind}")
@@ -582,8 +640,67 @@ class ServeMetrics:
             lines.append(f'{_SERVE_HIST}_bucket{{le="{le}"}} {cum}')
         lines.append(f"{_SERVE_HIST}_sum {lat_sum}")
         lines.append(f"{_SERVE_HIST}_count {cum}")
+        # per-class wait/beat histograms, exemplars on the worst rid
+        # per bucket (`# {trace_id="..."} value [ts]`) — families with
+        # no observations render nothing, so tracer-off is byte-stable
+        for fam, full, help_ in _SERVE_CLASS_HISTS:
+            classes = sorted(c for (k, c) in class_h if k == fam)
+            if not classes:
+                continue
+            lines.append(f"# TYPE {full} histogram")
+            lines.append(f"# HELP {full} {help_}")
+            for cls in classes:
+                h = class_h[(fam, cls)]
+                lbl = _escape_label(cls)
+                cum_c = 0
+                for i, (le, n) in enumerate(zip(BUCKET_LE_LABELS,
+                                                h["b"])):
+                    cum_c += n
+                    line = (f'{full}_bucket{{class="{lbl}",le="{le}"}}'
+                            f" {cum_c}")
+                    ex = h["ex"].get(i)
+                    if ex is not None:
+                        rid, ns, t_s = ex
+                        line += f' # {{trace_id="{rid}"}} {ns}'
+                        if t_s is not None:
+                            line += f" {_fmt(t_s)}"
+                    lines.append(line)
+                lines.append(f'{full}_sum{{class="{lbl}"}} {h["sum"]}')
+                lines.append(f'{full}_count{{class="{lbl}"}} {cum_c}')
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+
+# an OpenMetrics exemplar: `# {label="v",...} value [timestamp]`
+# appended to a `_bucket` (or counter `_total`) sample line
+_EXEMPLAR_RE = re.compile(
+    r'^\{(?:[A-Za-z_][A-Za-z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[A-Za-z_][A-Za-z0-9_]*="(?:[^"\\]|\\.)*")*)?\}'
+    r" (\S+)(?: (\S+))?$")
+
+
+def _check_exemplar(ex: str) -> str | None:
+    m = _EXEMPLAR_RE.match(ex)
+    if m is None:
+        return "malformed exemplar"
+    for tok in m.groups():
+        if tok is None:
+            continue
+        try:
+            float(tok)
+        except ValueError:
+            return f"unparseable exemplar value {tok!r}"
+    return None
+
+
+def _series_of(left: str) -> str:
+    """The label set of a sample's left-hand side, `le` pair removed —
+    the identity of one histogram series (per-class histograms put
+    several series under one family)."""
+    if "{" not in left:
+        return ""
+    labels = left.split("{", 1)[1].rsplit("}", 1)[0]
+    return re.sub(r'(^|,)le="[^"]*"', "", labels).strip(",")
 
 
 def validate_openmetrics(text: str) -> list[str]:
@@ -592,15 +709,19 @@ def validate_openmetrics(text: str) -> list[str]:
     well-formed: TYPE-before-samples, known kinds, counter samples
     suffixed `_total`, parseable values, no duplicate samples, and a
     final `# EOF` line. Histogram families get the full semantic
-    check: samples only via `_bucket`/`_sum`/`_count` suffixes,
-    `le`-labelled buckets in strictly increasing `le` order with
-    non-decreasing cumulative counts, a mandatory `+Inf` bucket, and
-    `_count` equal to the `+Inf` bucket's value."""
+    check PER LABELED SERIES (e.g. one series per `class` label):
+    samples only via `_bucket`/`_sum`/`_count` suffixes, `le`-labelled
+    buckets in strictly increasing `le` order with non-decreasing
+    cumulative counts, a mandatory `+Inf` bucket, and `_count` equal
+    to the `+Inf` bucket's value. Exemplars (`# {trace_id="..."} value
+    [ts]`) are accepted on `_bucket` and counter `_total` samples only
+    and must themselves parse."""
     errors: list[str] = []
     kinds: dict[str, str] = {}
     seen: set[str] = set()
-    # histogram family -> {"buckets": [(le, value)], "sum": x, "count": x}
-    hist: dict[str, dict] = {}
+    # (family, series labels) ->
+    #   {"buckets": [(le, value)], "sum": x, "count": x}
+    hist: dict[tuple[str, str], dict] = {}
     lines = text.split("\n")
     if not lines or lines[-1] != "" or len(lines) < 2 \
             or lines[-2] != "# EOF":
@@ -623,7 +744,10 @@ def validate_openmetrics(text: str) -> list[str]:
         if line.startswith("#"):
             errors.append(f"line {i}: unknown comment form: {line!r}")
             continue
-        left, _, value = line.rpartition(" ")
+        sample, exemplar = line, None
+        if " # " in line:
+            sample, exemplar = line.split(" # ", 1)
+        left, _, value = sample.rpartition(" ")
         name = left.split("{", 1)[0]
         family = name[:-6] if name.endswith("_total") else name
         # histogram samples resolve to their family by suffix
@@ -642,14 +766,24 @@ def validate_openmetrics(text: str) -> list[str]:
         if kinds[family] == "gauge" and name.endswith("_total"):
             errors.append(f"line {i}: gauge sample {name!r} must not "
                           "end with _total")
+        if exemplar is not None:
+            if hist_suffix != "_bucket" and not name.endswith("_total"):
+                errors.append(
+                    f"line {i}: exemplar on a sample that is neither a "
+                    f"histogram _bucket nor a counter _total: {line!r}")
+            ex_err = _check_exemplar(exemplar)
+            if ex_err is not None:
+                errors.append(f"line {i}: {ex_err}: {line!r}")
         try:
             val = float(value)
         except ValueError:
             errors.append(f"line {i}: unparseable value {value!r}")
             val = None
         if kinds[family] == "histogram":
+            series = _series_of(left)
             h = hist.setdefault(
-                family, {"buckets": [], "sum": None, "count": None})
+                (family, series),
+                {"buckets": [], "sum": None, "count": None})
             if hist_suffix is None:
                 errors.append(
                     f"line {i}: histogram sample {name!r} must use a "
@@ -672,31 +806,34 @@ def validate_openmetrics(text: str) -> list[str]:
     for family, kind in kinds.items():
         if kind != "histogram":
             continue
-        h = hist.get(family)
-        if h is None:
+        series_set = sorted(s for (f, s) in hist if f == family)
+        if not series_set:
             errors.append(f"histogram {family!r} declared but has no "
                           "samples")
             continue
-        buckets = h["buckets"]
-        les = [le for le, _ in buckets]
-        if les != sorted(les) or len(set(les)) != len(les):
-            errors.append(f"histogram {family!r}: le labels not "
-                          "strictly increasing")
-        vals = [v for _, v in buckets]
-        if vals != sorted(vals):
-            errors.append(f"histogram {family!r}: cumulative bucket "
-                          "counts decrease")
-        if not les or les[-1] != float("inf"):
-            errors.append(f"histogram {family!r}: missing mandatory "
-                          "+Inf bucket")
-        elif h["count"] is not None and h["count"] != vals[-1]:
-            errors.append(
-                f"histogram {family!r}: _count {h['count']} != +Inf "
-                f"bucket {vals[-1]}")
-        if h["count"] is None:
-            errors.append(f"histogram {family!r}: missing _count")
-        if h["sum"] is None:
-            errors.append(f"histogram {family!r}: missing _sum")
+        for series in series_set:
+            h = hist[(family, series)]
+            label = family if not series else f"{family}{{{series}}}"
+            buckets = h["buckets"]
+            les = [le for le, _ in buckets]
+            if les != sorted(les) or len(set(les)) != len(les):
+                errors.append(f"histogram {label!r}: le labels not "
+                              "strictly increasing")
+            vals = [v for _, v in buckets]
+            if vals != sorted(vals):
+                errors.append(f"histogram {label!r}: cumulative bucket "
+                              "counts decrease")
+            if not les or les[-1] != float("inf"):
+                errors.append(f"histogram {label!r}: missing mandatory "
+                              "+Inf bucket")
+            elif h["count"] is not None and h["count"] != vals[-1]:
+                errors.append(
+                    f"histogram {label!r}: _count {h['count']} != +Inf "
+                    f"bucket {vals[-1]}")
+            if h["count"] is None:
+                errors.append(f"histogram {label!r}: missing _count")
+            if h["sum"] is None:
+                errors.append(f"histogram {label!r}: missing _sum")
     return errors
 
 

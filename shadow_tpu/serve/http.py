@@ -9,7 +9,11 @@ silent logs). Endpoints:
 - GET  /result/<id>  200 done/error/timeout record, 202 while
                      queued/running (the record carries streamed
                      progress), 404 unknown
-- GET  /queue        packer + cache + launch snapshot
+- GET  /queue        packer (per-class depth + oldest-waiting age) +
+                     cache + launch snapshot
+- GET  /trace/<id>   the request's span tree (docs/18-Serve-Tracing.md);
+                     404 when tracing is off (--trace-requests) or the
+                     rid is unknown/evicted
 - GET  /metrics      serve-plane OpenMetrics (`ServeMetrics.render`)
 - GET  /healthz      {"status": "ok" | "draining" | "degraded"};
                      only "ok" is HTTP 200
@@ -78,6 +82,21 @@ class ServeHandler(BaseHandler):
                 status = (200 if rec["status"] in ("done", "error",
                                                    "timeout") else 202)
                 self._send(status, _json_bytes(rec), "application/json")
+        elif path.startswith("/trace/"):
+            rid = path[len("/trace/"):]
+            tree = svc.trace(rid)
+            if tree is not None:
+                self._send(200, _json_bytes(tree), "application/json")
+            elif svc.tracer is None:
+                self._send(404, _json_bytes(
+                    {"error": "tracing is off; start the service with "
+                              "--trace-requests (docs/18-Serve-"
+                              "Tracing.md)"}), "application/json")
+            else:
+                self._send(404, _json_bytes(
+                    {"error": f"no trace for request id {rid!r} "
+                              "(unknown or evicted)"}),
+                    "application/json")
         elif path == "/queue":
             self._send(200, _json_bytes(svc.queue_snapshot()),
                        "application/json")
@@ -118,7 +137,8 @@ class ServeServer:
         self._thread.start()
         host = self._httpd.server_address[0]
         print(f"serve: listening http://{host}:{self.port}/submit "
-              "(+/result/<id>, /queue, /metrics, /healthz)",
+              "(+/result/<id>, /trace/<id>, /queue, /metrics, "
+              "/healthz)",
               file=self._stream, flush=True)
         return self
 

@@ -253,10 +253,22 @@ class LanePacker:
         return sorted(out, key=lambda r: r.seq)
 
     def snapshot(self) -> dict:
+        """GET /queue's packer view: per-class depth AND oldest-waiting
+        age (head enqueue timestamp vs now) — degraded-mode triage
+        reads which class is starving without needing the trace
+        ledger."""
+        now = self._clock()
         with self._lock:
             return {
                 "depth": sum(len(d) for d in self._q.values()),
-                "classes": {str(k): len(d) for k, d in self._q.items()},
+                "classes": {
+                    str(k): {
+                        "depth": len(d),
+                        "oldest_wait_s": round(max(now - d[0][1], 0.0),
+                                               3),
+                    }
+                    for k, d in self._q.items()
+                },
                 "max_lanes": self.max_lanes,
                 "deadline_s": self.deadline_s,
             }

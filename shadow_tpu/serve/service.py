@@ -249,6 +249,7 @@ class SimService:
                  degraded_after: int = 3,
                  diag_dir: str = ".",
                  chaos=None,
+                 tracer=None,
                  watchdog_exit=None):
         if max_lanes < 1:
             raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
@@ -264,6 +265,12 @@ class SimService:
                                  pack_deadline_ms / 1000.0, clock=clock)
         self._fleet_factory = fleet_factory
         self._clock = clock
+        # request-scoped tracing (docs/18-Serve-Tracing.md): every call
+        # site is guarded on `self._tracer is not None`, so tracer-off
+        # keeps the hot path — and the HTTP surface — byte-identical
+        self._tracer = tracer
+        if tracer is not None and tracer.metrics is None:
+            tracer.metrics = self.metrics
         self._cond = threading.Condition()
         self._results: dict[str, dict] = {}
         self._submit_t: dict[str, float] = {}
@@ -294,9 +301,14 @@ class SimService:
                           if snapshot_path else None)
             chaos = chaos_mod.from_env(marker_dir=marker_dir)
         if chaos is not None and chaos._on_inject is None:
-            # explicitly-passed injectors count the same as env ones
-            chaos._on_inject = (
-                lambda kind: self.metrics.inc("serve_chaos_injected"))
+            # explicitly-passed injectors count the same as env ones;
+            # injections also land in the trace ledger when tracing
+            def _note_chaos(kind):
+                self.metrics.inc("serve_chaos_injected")
+                if self._tracer is not None:
+                    self._tracer.event("chaos", chaos_kind=kind)
+
+            chaos._on_inject = _note_chaos
         self._chaos = chaos
 
         self._watchdog = None
@@ -307,7 +319,9 @@ class SimService:
                 float(launch_deadline_s), diag_dir=diag_dir,
                 label="shadow_tpu.serve", kind="launchstall",
                 info=lambda: {"plane": "serve",
-                              "launches": self._launches},
+                              "launches": self._launches,
+                              **({"trace_recent": self._tracer.recent()}
+                                 if self._tracer is not None else {})},
                 **({"_exit": watchdog_exit} if watchdog_exit else {}),
             )
             # the watchdog covers a BEAT, not the process: idle time
@@ -319,6 +333,7 @@ class SimService:
     def submit(self, doc: dict) -> dict:
         """Validate, classify, queue. Raises ValueError (HTTP 400) on a
         bad request, ServiceDraining/ServiceDegraded (503) otherwise."""
+        t_in = self._tracer.now() if self._tracer is not None else 0.0
         with self._cond:
             if self._stopping:
                 raise ServiceDraining("service is draining; resubmit "
@@ -342,6 +357,10 @@ class SimService:
             self.packer.push(key, req)
             self.metrics.set("serve_queue_depth", self.packer.depth())
             self._evict_results_locked()
+            if self._tracer is not None:
+                self._tracer.span("submit", t0=t_in,
+                                  t1=self._submit_t[rid], rid=rid,
+                                  cls=str(key), seq=seq)
             self._cond.notify()
         return {"request_id": rid, "class": str(key)}
 
@@ -365,6 +384,17 @@ class SimService:
             "launches": launches,
             "draining": draining,
         }
+
+    @property
+    def tracer(self):
+        return self._tracer
+
+    def trace(self, rid: str) -> dict | None:
+        """The request's span tree (GET /trace/<rid>), or None when
+        tracing is off or the rid is unknown/evicted."""
+        if self._tracer is None:
+            return None
+        return self._tracer.trace(rid)
 
     def health(self) -> dict:
         """/healthz body: {"status": "ok"|"draining"|"degraded"} plus
@@ -398,6 +428,9 @@ class SimService:
                 break
             self._done_order.popitem(last=False)
             self._results.pop(rid, None)
+            if self._tracer is not None:
+                # /trace retention tracks /result retention exactly
+                self._tracer.forget(rid)
             evicted += 1
         if evicted:
             self.metrics.inc("serve_results_evicted", evicted)
@@ -548,6 +581,15 @@ class SimService:
                     reqs = self.packer.pop(key)
                     self.metrics.set("serve_queue_depth",
                                      self.packer.depth())
+                if self._tracer is not None and reqs:
+                    # queue_wait: submit (or resume registration) to the
+                    # moment the worker claims the batch
+                    t_pop = self._tracer.now()
+                    for r in reqs:
+                        self._tracer.span(
+                            "queue_wait",
+                            t0=self._submit_t.get(r.rid, t_pop),
+                            t1=t_pop, rid=r.rid, cls=str(key))
             if not reqs:
                 continue
             try:
@@ -579,8 +621,18 @@ class SimService:
                         f"(backoff {backoff:.2f}s)",
                         file=sys.stderr, flush=True,
                     )
+                    tr = self._tracer
+                    t_r0 = tr.now() if tr is not None else 0.0
                     if backoff > 0:
                         time.sleep(backoff)
+                    if tr is not None:
+                        # the retry span covers the backoff sleep, so a
+                        # retried request's spans still tile its wall
+                        tr.span("retry", t0=t_r0, t1=tr.now(),
+                                rids=[r.rid for r in reqs],
+                                cls=str(key), attempt=attempt,
+                                backoff_s=backoff,
+                                error=f"{type(e).__name__}: {e}")
                     continue
                 if len(reqs) > 1:
                     # retries exhausted on a multi-request batch: split
@@ -589,6 +641,11 @@ class SimService:
                     # dead attempt's snapshot no longer matches them.
                     self.metrics.inc("serve_bisections")
                     self._clear_snapshot()
+                    if self._tracer is not None:
+                        self._tracer.event(
+                            "bisect", rids=[r.rid for r in reqs],
+                            cls=str(key), depth=depth, size=len(reqs),
+                            error=f"{type(e).__name__}: {e}")
                     mid = len(reqs) // 2
                     print(
                         f"serve: bisecting {len(reqs)}-request batch of "
@@ -615,6 +672,11 @@ class SimService:
         """Terminal failure: per-rid error records, metrics, and the
         degraded-mode failure streak."""
         self.metrics.inc("serve_errors", len(reqs))
+        if self._tracer is not None:
+            for r in reqs:
+                self._tracer.event(
+                    "result", rid=r.rid, cls=str(key), status="error",
+                    error=f"{type(e).__name__}: {e}")
         with self._cond:
             for r in reqs:
                 self._results[r.rid] = {
@@ -759,10 +821,13 @@ class SimService:
     def _launch(self, key: ClassKey, reqs: list) -> None:
         import numpy as np
 
+        tr = self._tracer
+        t_entry = tr.now() if tr is not None else 0.0
         hits_before = self.cache.hits
         factory = (self._fleet_factory or self._build_entry)
         entry = self.cache.get(key, lambda: factory(key, reqs[0]))
         cache_hit = self.cache.hits > hits_before
+        t_cache = tr.now() if tr is not None else 0.0
         fleet = entry.fleet
         L = fleet.lanes
         R = len(reqs)
@@ -774,6 +839,12 @@ class SimService:
                     "request_id": r.rid, "status": "running",
                     "class": str(key), "lane": i, "launch": launch_no,
                 }
+        if tr is not None:
+            # cache-hit-vs-compile: a cold get's duration IS the compile
+            tr.span("cache", t0=t_entry, t1=t_cache, launch=launch_no,
+                    cls=str(key), hit=cache_hit)
+            for r in reqs:
+                tr.associate(r.rid, launch_no)
         self.metrics.inc("serve_launches")
         self.metrics.inc("serve_lanes", R)
         self.metrics.set("serve_last_lanes_packed", R)
@@ -792,6 +863,10 @@ class SimService:
                 st = fleet.adopt_state(loaded[0])
                 beats_done = resumed_from = loaded[1]
                 self.metrics.inc("serve_resumes")
+                if tr is not None:
+                    tr.event("resume", launch=launch_no, cls=str(key),
+                             from_beat=resumed_from,
+                             rids=[r.rid for r in reqs])
         # wall deadlines: per-request (deadline_ms from submit time) and
         # per-beat (the launch watchdog) — both off by default
         deadline_at = {}
@@ -804,11 +879,23 @@ class SimService:
         timed_out: set[int] = set()
         if self._watchdog is not None:
             self._watchdog.arm()
+        if tr is not None:
+            t_run0 = tr.now()
+            # pack = launch entry -> first dispatch: cache get/compile,
+            # result-record setup, make_inputs, snapshot load
+            tr.span("pack", t0=t_entry, t1=t_run0, launch=launch_no,
+                    cls=str(key), lanes_packed=R, max_lanes=L,
+                    rids=[r.rid for r in reqs],
+                    resumed_from_beat=resumed_from)
+            for r in reqs:
+                tr.span("pack_wait", t0=t_entry, t1=t_run0, rid=r.rid,
+                        launch=launch_no, cls=str(key))
         try:
             # beat loop: beat_windows fixed-window steps per harvest —
             # the single-fetch heartbeat that streams per-lane progress
             while True:
                 beat = beats_done + 1
+                t_b0 = tr.now() if tr is not None else 0.0
                 if self._chaos:
                     self._chaos.fire(
                         "beat", beat=beat,
@@ -818,9 +905,20 @@ class SimService:
                 st, bundle = entry.harvest.extract(st, full=False)
                 if self._chaos:
                     self._chaos.fire("fetch", beat=beat)
+                t_f0 = tr.now() if tr is not None else 0.0
                 fetched = entry.harvest.fetch(bundle)
                 sums = entry.harvest.lane_summaries_from(fetched)
                 beats_done = beat
+                if tr is not None:
+                    t_b1 = tr.now()
+                    tr.span(
+                        "beat", t0=t_b0, t1=t_b1, launch=launch_no,
+                        cls=str(key), beat=beat,
+                        windows=self.beat_windows,
+                        fetch_s=round(t_b1 - t_f0, 6),
+                        lanes=[{"lane": i, "rid": r.rid,
+                                "now_ns": int(sums[i]["now_ns"])}
+                               for i, r in enumerate(reqs)])
                 if self._watchdog is not None:
                     self._watchdog.pet(beat=beats_done,
                                        launch=launch_no)
@@ -836,19 +934,31 @@ class SimService:
                                 and i in deadline_at
                                 and now >= deadline_at[i]):
                             timed_out.add(i)
+                            if tr is not None:
+                                tr.event("deadline_exceeded", t=now,
+                                         rid=r.rid, cls=str(key),
+                                         launch=launch_no,
+                                         beat=beats_done,
+                                         deadline_ms=r.deadline_ms)
                 if all(i in timed_out or sums[i]["now_ns"] >= r.stop_ns
                        for i, r in enumerate(reqs)):
                     break
                 if (self._snapshot_enabled()
                         and beats_done % self.snapshot_beats == 0):
+                    t_s0 = tr.now() if tr is not None else 0.0
                     self._write_snapshot(key, reqs, st, beats_done,
                                          stops)
+                    if tr is not None:
+                        tr.span("snapshot", t0=t_s0, t1=tr.now(),
+                                launch=launch_no, cls=str(key),
+                                beats_done=beats_done)
             # one confirming step: a lane whose last REAL window landed
             # exactly on its stop has not yet run the done-branch
             # exchange flush (the fused run's epilogue); this step fires
             # it for every lane (idempotent for lanes already done) so
             # the harvested summaries equal the fused solo run's
             # state_summary bit-for-bit
+            t_c0 = tr.now() if tr is not None else 0.0
             st = fleet.step_window(st, stops, binds=binds)
             _, bundle = entry.harvest.extract(st, full=False)
             sums = entry.harvest.lane_summaries_from(
@@ -857,6 +967,11 @@ class SimService:
             if self._watchdog is not None:
                 self._watchdog.disarm()
         done_t = self._clock()
+        if tr is not None:
+            # confirm: the epilogue step + final harvest through result
+            # delivery — the last tile of every rider's wall timeline
+            tr.span("confirm", t0=t_c0, t1=done_t, launch=launch_no,
+                    cls=str(key), rids=[r.rid for r in reqs])
         n_done = 0
         with self._cond:
             for i, r in enumerate(reqs):
@@ -873,6 +988,11 @@ class SimService:
                         "wall_ms": round(wall_s * 1e3, 3),
                     }
                     self._note_terminal_locked(r.rid)
+                    if tr is not None:
+                        tr.event("result", t=done_t, rid=r.rid,
+                                 cls=str(key), status="timeout",
+                                 launch=launch_no, lane=i,
+                                 wall_ms=round(wall_s * 1e3, 3))
                     continue
                 n_done += 1
                 rec = {
@@ -889,6 +1009,12 @@ class SimService:
                     rec["beats"] = beats_done
                 self._results[r.rid] = rec
                 self._note_terminal_locked(r.rid)
+                if tr is not None:
+                    tr.event("result", t=done_t, rid=r.rid,
+                             cls=str(key), status="done",
+                             launch=launch_no, lane=i,
+                             cache_hit=cache_hit,
+                             wall_ms=rec["wall_ms"])
                 self.metrics.observe_latency_ns(int(wall_s * 1e9))
             self._evict_results_locked()
         if timed_out:

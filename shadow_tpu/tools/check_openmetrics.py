@@ -5,7 +5,12 @@ harnesses (measure_all.sh's metrics_smoke / stats_smoke stages) can
 gate on exporter output without a prometheus toolchain in the
 container. Histogram families (the --stats expositions) get the full
 semantic check: monotone `le` bucket ordering, the mandatory `+Inf`
-bucket, and `_count`/`_sum` reconciliation against the bucket totals.
+bucket, and `_count`/`_sum` reconciliation against the bucket totals —
+applied per label-series, so the serve plane's per-class histograms
+(`class="..."` with one bucket ladder per equivalence class,
+docs/18-Serve-Tracing.md) are each checked independently. OpenMetrics
+exemplars (`... # {trace_id="r000001"} <value> <ts>`) are validated
+for syntax and for appearing only on `_bucket`/`_total` samples.
 Reads a scrape from a file or stdin; prints one violation per line and
 exits 1 on any.
 

@@ -35,6 +35,11 @@ JSON_T, OPENMETRICS_T, HEARTBEAT_T = "json", "openmetrics", "heartbeat"
 # summary so it diffs directly against a solo-run summary with sim keys
 # exact (the serving bit-identity gate)
 SERVED_T = "served"
+# a serve flight ledger (docs/18-Serve-Tracing.md): JSONL whose header
+# line carries ledger_version — loaded as the record list, so two
+# replayed request streams diff span-for-span with sim keys (now_ns)
+# exact and wall keys (t_s/dur_s/wall_ms) under --rtol
+LEDGER_T = "ledger"
 
 # numeric keys that are wall-clock (not sim) quantities: always
 # compared with the tolerance, never exactly, because two bit-identical
@@ -51,6 +56,9 @@ def classify(path: str, text: str) -> str:
     # "[" would otherwise claim
     if "[shadow-heartbeat]" in text:
         return HEARTBEAT_T
+    first = stripped.split("\n", 1)[0]
+    if first.startswith("{") and '"ledger_version"' in first:
+        return LEDGER_T
     if stripped.startswith("{") or stripped.startswith("["):
         if stripped.startswith("{") and '"request_id"' in text:
             return SERVED_T
@@ -132,6 +140,14 @@ def load_artifact(path: str) -> tuple[str, Any]:
         # the solo-run artifact; request metadata (lane, launch,
         # wall_ms) is serving detail, not run output
         return JSON_T, summary
+    if kind == LEDGER_T:
+        from shadow_tpu.obs.servetrace import load_ledger
+
+        _, records = load_ledger(path)
+        # diff as a plain record list: `now_ns` attrs compare exactly
+        # (replayed streams must agree on sim progress), the wall keys
+        # (t_s, dur_s, fetch_s, wall_ms, backoff_s) hit _WALL_HINTS
+        return kind, records
     if kind == OPENMETRICS_T:
         return kind, load_openmetrics(text)
     return kind, load_heartbeat(text)

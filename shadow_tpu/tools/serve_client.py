@@ -7,6 +7,13 @@ request — the exact artifact `tools/diff_runs.py` diffs against a solo
 summary for the bit-identity gate), and prints one machine-readable
 JSON line with throughput and latency percentiles.
 
+When the server runs with `--trace-requests` (docs/18-Serve-Tracing.md)
+the client also fetches each request's span tree from `/trace/<id>`,
+writes it beside the result artifact (`<request_id>.trace.json`), and
+the report gains per-class p50/p95/p99 of the queue-wait / pack-wait /
+run decomposition. A server without tracing answers 404 there; the
+client just skips those fields.
+
 The default mix alternates two static-knob equivalence classes over one
 phold shape — a plain seed sweep and a crash-fault class with varied
 stop times and latency scales — so a 16-request run exercises lane
@@ -58,6 +65,22 @@ def _http(url: str, data: bytes | None = None, timeout: float = 10.0):
         return resp.status, json.loads(resp.read().decode("utf-8"))
 
 
+def fetch_traces(url: str, rids: list[str]) -> dict[str, dict]:
+    """Span trees for completed requests, `{}` when tracing is off
+    (the server 404s every /trace/<id> then)."""
+    trees: dict[str, dict] = {}
+    for rid in rids:
+        try:
+            status, tree = _http(f"{url}/trace/{rid}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                continue  # tracing off, or the rid was evicted
+            raise
+        if status == 200:
+            trees[rid] = tree
+    return trees
+
+
 def submit_all(url: str, docs: list[dict]) -> list[str]:
     rids = []
     for doc in docs:
@@ -98,12 +121,34 @@ def _pct(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[i]
 
 
+def _class_decomposition(trees: dict[str, dict]) -> dict:
+    """Per-class p50/p95/p99 of queue-wait / pack-wait / run, from the
+    span trees (shares `obs.servetrace.decompose` with serve_report)."""
+    from shadow_tpu.obs.servetrace import decompose
+
+    by_class: dict[str, list[dict]] = {}
+    for tree in trees.values():
+        by_class.setdefault(tree.get("class") or "?", []).append(
+            decompose(tree))
+    out = {}
+    for cls, ds in sorted(by_class.items()):
+        ent = {}
+        for key in ("queue_wait_ms", "pack_wait_ms", "run_ms"):
+            vals = sorted(d[key] for d in ds)
+            ent[key] = {"p50": _pct(vals, 0.50),
+                        "p95": _pct(vals, 0.95),
+                        "p99": _pct(vals, 0.99)}
+        out[cls] = ent
+    return out
+
+
 def run_load(url: str, docs: list[dict], *, out_dir: str | None = None,
              timeout_s: float = 600.0) -> dict:
     t0 = time.monotonic()
     rids = submit_all(url, docs)
     recs = poll_results(url, rids, timeout_s=timeout_s)
     wall_s = time.monotonic() - t0
+    trees = fetch_traces(url, rids)
     if out_dir is not None:
         import os
 
@@ -111,6 +156,11 @@ def run_load(url: str, docs: list[dict], *, out_dir: str | None = None,
         for rid, rec in recs.items():
             with open(os.path.join(out_dir, f"{rid}.json"), "w") as f:
                 json.dump(rec, f, sort_keys=True, indent=1)
+                f.write("\n")
+        for rid, tree in trees.items():
+            path = os.path.join(out_dir, f"{rid}.trace.json")
+            with open(path, "w") as f:
+                json.dump(tree, f, sort_keys=True, indent=1)
                 f.write("\n")
     done = [r for r in recs.values() if r["status"] == "done"]
     lat = sorted(r["wall_ms"] for r in done)
@@ -127,6 +177,9 @@ def run_load(url: str, docs: list[dict], *, out_dir: str | None = None,
         "launches": len({r["launch"] for r in done}),
         "cache_hits_seen": sum(1 for r in done if r.get("cache_hit")),
     }
+    if trees:
+        report["traced"] = len(trees)
+        report["class_decomposition"] = _class_decomposition(trees)
     return report
 
 

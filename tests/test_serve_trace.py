@@ -1,0 +1,563 @@
+"""Serve-plane tracing pins (ISSUE 18, docs/18-Serve-Tracing.md).
+
+The contract, layer by layer:
+
+- span-tree completeness (the headline pin): a packed 4-lane batch,
+  including one chaos-injected retry, yields per-request trees whose
+  queue-wait + pack-wait + run (+ retry backoff) spans tile the
+  recorded end-to-end `wall_ms` within tolerance — latency is
+  *accounted for*, not just measured;
+- failure spans: retry/resume, bisection, and per-request deadline
+  timeouts each leave their named record in the tree;
+- flight ledger: replayed request streams produce span-for-span
+  comparable ledgers (sim keys exact), `load_ledger` survives a torn
+  tail, `diff_runs` classifies + diffs it, `serve_report` reduces it;
+- zero-change-off: with no tracer, /trace 404s with a pointer, the
+  /metrics exposition carries none of the per-class families, and the
+  result records are unchanged;
+- per-class histograms: exemplars render, pass `validate_openmetrics`,
+  and the validator rejects malformed exemplar placements;
+- merged export: `export_trace --serve-ledger` emits one valid,
+  byte-deterministic Chrome trace with serve wall tracks (pid 2),
+  per-lane sim-time rows (pid 3), and beat->lane flow arrows.
+
+All on the injected fake fleet from test_serve — no compiles.
+"""
+
+import json
+import time
+
+from test_serve import (
+    _doc,
+    _fake_entry_factory,
+    _quiet_service,
+    _tot,
+    _wait_done,
+)
+
+from shadow_tpu.obs.metrics import ServeMetrics, validate_openmetrics
+from shadow_tpu.obs.servetrace import (
+    ServeTracer,
+    decompose,
+    load_ledger,
+)
+from shadow_tpu.serve.service import SimService
+
+# one packed 4-lane launch, fast: the fake fleet advances 50ms of sim
+# time per window, so stop_s=0.5 is 10 windows = 5 beats at windows=2
+
+
+def _traced_service(ledger=None, *, lanes=4, tracer_kw=None, **kw):
+    tracer = ServeTracer(ledger_file=ledger, **(tracer_kw or {}))
+    kw.setdefault("max_lanes", lanes)
+    kw.setdefault("pack_deadline_ms", 30.0)
+    kw.setdefault("beat_windows", 2)
+    svc = SimService(fleet_factory=_fake_entry_factory(lanes),
+                     tracer=tracer, **kw)
+    return svc, tracer
+
+
+def _span_names(tree):
+    return [s["name"] for s in tree["spans"]]
+
+
+def _launch_spans(tree, name):
+    return [s for launch in tree["launches"] for s in launch["spans"]
+            if s["name"] == name]
+
+
+# ------------------------------------------------- span-tree completeness
+
+
+def test_span_tree_happy_path_tiles_wall_time():
+    svc, tracer = _traced_service()
+    svc.start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)]
+        recs = _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+
+    for rid in rids:
+        tree = svc.trace(rid)
+        assert tree is not None and tree["request_id"] == rid
+        names = _span_names(tree)
+        for required in ("submit", "queue_wait", "pack_wait", "result"):
+            assert required in names, (rid, names)
+        assert tree["class"].startswith("phold(")
+        # launch-scoped spans: cache decision, pack, >=1 beat, confirm
+        assert len(tree["launches"]) == 1
+        for required in ("cache", "pack", "beat", "confirm"):
+            assert _launch_spans(tree, required), required
+        # every beat span carries this request's per-lane sim progress
+        beats = _launch_spans(tree, "beat")
+        mine = [e for s in beats for e in s["lanes"]
+                if e["rid"] == rid]
+        assert len(mine) == len(beats)
+        assert mine[-1]["now_ns"] == 500_000_000  # ran to stop
+
+        # the acceptance tiling: decomposition ~ end-to-end wall_ms.
+        # Slack = worker pickup + terminal bookkeeping, bounded tight.
+        d = decompose(tree)
+        assert d["status"] == "done" and d["total_ms"] is not None
+        accounted = (d["queue_wait_ms"] + d["pack_wait_ms"]
+                     + d["run_ms"] + d["retry_ms"])
+        assert accounted <= d["total_ms"] + 5.0
+        assert accounted >= 0.5 * d["total_ms"] - 5.0, (d, tree)
+
+
+def test_span_tree_retry_resume_still_tiles(tmp_path):
+    from shadow_tpu.serve.chaos import ServeChaos
+
+    snap = str(tmp_path / "snap.npz")
+    svc, tracer = _traced_service(
+        snapshot_beats=2, snapshot_path=snap,
+        launch_retries=1, launch_backoff_s=0.05,
+        chaos=ServeChaos("raise:beat=3"))
+    svc.start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)]
+        recs = _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+    assert _tot(svc, "serve_launch_retries") == 1
+
+    for rid in rids:
+        assert recs[rid]["status"] == "done"
+        tree = svc.trace(rid)
+        names = _span_names(tree)
+        # the retry span (covering the backoff) files under every rider
+        assert "retry" in names
+        retry = next(s for s in tree["spans"] if s["name"] == "retry")
+        assert retry["attempt"] == 1
+        assert retry["dur_s"] >= 0.05  # covers the backoff sleep
+        assert rid in retry["rids"]
+        # two launches: the chaos victim and the resumed attempt, and
+        # the second one resumed from the snapshot beat
+        assert len(tree["launches"]) == 2
+        resumes = [s for launch in tree["launches"]
+                   for s in launch["spans"] if s["name"] == "resume"]
+        assert len(resumes) == 1 and resumes[0]["from_beat"] == 2
+        # chaos injection left its mark
+        assert any(s["name"] == "chaos" for s in tracer.recent()) or \
+            _tot(svc, "serve_chaos_injected") == 1
+
+        d = decompose(tree)
+        accounted = (d["queue_wait_ms"] + d["pack_wait_ms"]
+                     + d["run_ms"] + d["retry_ms"])
+        assert d["retry_ms"] >= 50.0  # the backoff is accounted for
+        assert accounted <= d["total_ms"] + 5.0
+        assert accounted >= 0.5 * d["total_ms"] - 5.0, d
+
+
+def test_bisection_and_error_events_in_tree():
+    from shadow_tpu.serve.chaos import ServeChaos
+
+    svc, tracer = _traced_service(
+        launch_retries=0, launch_backoff_s=0.0,
+        chaos=ServeChaos("poison:seed=13"))
+    svc.start()
+    try:
+        rids = {s: svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)}
+        recs = _wait_done(svc, list(rids.values()), timeout_s=60,
+                          poll_s=0.05)
+    finally:
+        svc.drain()
+
+    poison = svc.trace(rids[13])
+    bisects = [s for s in poison["spans"] if s["name"] == "bisect"]
+    # [11,12,13,14] -> [13,14] -> [13]: the poison rid sees both rounds
+    assert len(bisects) == 2
+    assert bisects[0]["size"] == 4 and bisects[1]["size"] == 2
+    result = next(s for s in poison["spans"] if s["name"] == "result")
+    assert result["status"] == "error"
+    assert "poison seed 13" in result["error"]
+    # a rider that completed has a done result span and its own tree
+    rider = svc.trace(rids[11])
+    assert any(s["name"] == "result" and s["status"] == "done"
+               for s in rider["spans"])
+
+
+def test_deadline_timeout_event_in_tree():
+    svc, tracer = _traced_service(lanes=2, pack_deadline_ms=1.0)
+    svc.start()
+    try:
+        fast = svc.submit(_doc(1, stop_s=0.5))["request_id"]
+        slow = svc.submit({**_doc(2, stop_s=600.0),
+                           "deadline_ms": 150})["request_id"]
+        recs = _wait_done(svc, [fast, slow], timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+
+    assert recs[slow]["status"] == "timeout"
+    tree = svc.trace(slow)
+    ddl = [s for s in tree["spans"] if s["name"] == "deadline_exceeded"]
+    assert len(ddl) == 1 and ddl[0]["deadline_ms"] == 150
+    result = next(s for s in tree["spans"] if s["name"] == "result")
+    assert result["status"] == "timeout"
+
+
+# --------------------------------------------------------- flight ledger
+
+
+def _ledger_run(tmp_path, tag, seeds=(11, 12, 13, 14)):
+    svc, tracer = _traced_service(str(tmp_path / f"{tag}.jsonl"))
+    svc.start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"] for s in seeds]
+        _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+        tracer.close()
+    return tracer.ledger_path
+
+
+def test_ledger_replay_sim_keys_identical(tmp_path):
+    pa = _ledger_run(tmp_path, "a")
+    pb = _ledger_run(tmp_path, "b")
+    ha, ra = load_ledger(pa)
+    hb, rb = load_ledger(pb)
+    assert ha["ledger_version"] == hb["ledger_version"] == 1
+
+    def skeleton(recs):
+        # everything deterministic across replays: record kinds/names in
+        # order, their request/launch attribution, and per-lane sim time
+        out = []
+        for r in recs:
+            out.append((r["kind"], r["name"], r.get("rid"),
+                        tuple(r.get("rids", ())), r.get("launch"),
+                        tuple((e["lane"], e["rid"], e["now_ns"])
+                              for e in r.get("lanes", ()))))
+        return out
+
+    assert skeleton(ra) == skeleton(rb)
+    # ... and the diff_runs gate agrees: a ledger diffed against itself
+    # is zero drift, against its replay only wall keys move
+    from shadow_tpu.tools.diff_runs import LEDGER_T, diff_files, load_artifact
+
+    kind, recs = load_artifact(pa)
+    assert kind == LEDGER_T and len(recs) == len(ra)
+    assert diff_files(pa, pa, rtol=0.0) == []
+    drift = diff_files(pa, pb, rtol=1e9)  # wall keys tolerated away
+    assert [e for e in drift if "now_ns" in e["key"]] == []
+
+
+def test_ledger_tolerates_torn_tail(tmp_path):
+    path = _ledger_run(tmp_path, "torn")
+    _, whole = load_ledger(path)
+    with open(path, "a") as f:
+        f.write('{"kind": "span", "name": "trunc')  # dying process
+    _, records = load_ledger(path)
+    assert len(records) == len(whole)
+
+
+def test_serve_report_reduces_ledger(tmp_path):
+    from shadow_tpu.serve.chaos import ServeChaos
+    from shadow_tpu.tools.serve_report import reduce_ledger
+
+    snap = str(tmp_path / "snap.npz")
+    svc, tracer = _traced_service(
+        str(tmp_path / "ledger.jsonl"),
+        snapshot_beats=2, snapshot_path=snap,
+        launch_retries=1, launch_backoff_s=0.05,
+        chaos=ServeChaos("raise:beat=3"))
+    svc.start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)]
+        _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+        tracer.close()
+
+    header, records = load_ledger(tracer.ledger_path)
+    report = reduce_ledger(header, records)
+    assert report["requests"] == 4
+    assert report["launches"] == 2  # chaos victim + resumed attempt
+    assert report["retries"] == 1
+    assert report["retry_backoff_s"] >= 0.05
+    assert report["chaos_injections"] == 1
+    assert report["snapshots"] >= 1
+    assert report["pack_efficiency"] == 1.0  # both packs fully laned
+    assert report["cache_lookups"] == 2
+    assert report["cache_hit_ratio"] == 0.5  # second launch reuses
+    (cls,) = report["classes"]
+    ent = report["classes"][cls]
+    assert ent["requests"] == ent["done"] == 4
+    for key in ("queue_wait_ms", "pack_wait_ms", "run_ms", "total_ms"):
+        assert ent[key]["p50"] <= ent[key]["p95"] <= ent[key]["p99"]
+    assert ent["total_ms"]["p50"] > 0
+
+    # the CLI prints the same report as one JSON line
+    import io
+    from contextlib import redirect_stdout
+
+    from shadow_tpu.tools.serve_report import main as report_main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert report_main([tracer.ledger_path]) == 0
+    assert json.loads(buf.getvalue()) == json.loads(
+        json.dumps(report, sort_keys=True))
+
+
+def test_decompose_unit():
+    tree = {
+        "request_id": "r1",
+        "class": "c",
+        "spans": [
+            {"kind": "span", "name": "queue_wait", "t_s": 0.0,
+             "dur_s": 0.010, "rid": "r1"},
+            {"kind": "span", "name": "pack_wait", "t_s": 0.010,
+             "dur_s": 0.005, "rid": "r1"},
+            {"kind": "span", "name": "retry", "t_s": 0.1, "dur_s": 0.05,
+             "rids": ["r1", "r2"]},
+            {"kind": "event", "name": "result", "t_s": 0.2, "dur_s": 0.0,
+             "rid": "r1", "status": "done", "wall_ms": 200.0},
+        ],
+        "launches": [{"launch": 0, "spans": [
+            {"kind": "span", "name": "beat", "t_s": 0.02, "dur_s": 0.03,
+             "launch": 0, "lanes": [{"lane": 0, "rid": "r1",
+                                     "now_ns": 100}]},
+            {"kind": "span", "name": "beat", "t_s": 0.05, "dur_s": 0.03,
+             "launch": 0, "lanes": [{"lane": 0, "rid": "OTHER",
+                                     "now_ns": 100}]},
+            {"kind": "span", "name": "confirm", "t_s": 0.08,
+             "dur_s": 0.002, "launch": 0, "rids": ["r1"]},
+        ]}],
+    }
+    d = decompose(tree)
+    assert d == {"queue_wait_ms": 10.0, "pack_wait_ms": 5.0,
+                 "run_ms": 32.0, "retry_ms": 50.0, "beats": 1,
+                 "total_ms": 200.0, "status": "done"}
+
+
+# ------------------------------------------------------- zero-change off
+
+
+def test_tracer_off_surface_unchanged():
+    svc = SimService(max_lanes=4, pack_deadline_ms=30.0, beat_windows=2,
+                     fleet_factory=_fake_entry_factory(4)).start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)]
+        recs = _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+
+    assert svc.tracer is None
+    assert svc.trace(rids[0]) is None
+    # no per-class histogram family leaks into the exposition
+    scrape = svc.metrics.render()
+    assert "serve_queue_wait_ns" not in scrape
+    assert "serve_pack_wait_ns" not in scrape
+    assert "serve_beat_wall_ns" not in scrape
+    assert " # {" not in scrape  # no exemplars anywhere
+    assert validate_openmetrics(scrape) == []
+    # the result record schema is exactly the untraced one
+    assert all("trace" not in k for k in recs[rids[0]])
+
+
+def test_trace_http_endpoint_on_off(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from shadow_tpu.serve.http import ServeServer
+
+    def get(port, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    # off: 404 with the how-to-enable pointer
+    svc = _quiet_service().start()
+    srv = ServeServer(svc, _stream=open("/dev/null", "w")).start()
+    try:
+        code, doc = get(srv.port, "/trace/r000000")
+        assert code == 404 and "--trace-requests" in doc["error"]
+    finally:
+        srv.close()
+        svc.drain()
+
+    # on: a traced rid serves its tree; unknown rids still 404
+    svc2, tracer = _traced_service()
+    svc2.start()
+    srv2 = ServeServer(svc2, _stream=open("/dev/null", "w")).start()
+    try:
+        rids = [svc2.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)]
+        _wait_done(svc2, rids, timeout_s=60, poll_s=0.05)
+        code, tree = get(srv2.port, f"/trace/{rids[0]}")
+        assert code == 200 and tree["request_id"] == rids[0]
+        assert any(s["name"] == "result" for s in tree["spans"])
+        code, doc = get(srv2.port, "/trace/nope")
+        assert code == 404 and "unknown or evicted" in doc["error"]
+        # the /queue satellite: per-class depth + oldest-waiting age
+        code, q = get(srv2.port, "/queue")
+        assert code == 200 and q["packer"]["classes"] == {}
+    finally:
+        srv2.close()
+        svc2.drain()
+
+
+def test_trace_retention_tracks_result_eviction():
+    svc, tracer = _traced_service(max_results=2)
+    svc.start()
+    try:
+        rids = [svc.submit(_doc(s))["request_id"]
+                for s in (11, 12, 13, 14)]
+        # the cap evicts two records the moment the batch lands, so
+        # poll for the settled shape instead of 4 terminal records
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            recs = {r: svc.result(r) for r in rids}
+            if sum(x is None for x in recs.values()) == 2 and all(
+                    x["status"] == "done" for x in recs.values()
+                    if x is not None):
+                break
+            time.sleep(0.05)
+        evicted = [r for r in rids if svc.result(r) is None]
+        kept = [r for r in rids if r not in evicted]
+        assert len(evicted) == 2
+        for r in evicted:
+            assert svc.trace(r) is None, "trace outlived its result"
+        for r in kept:
+            assert svc.trace(r) is not None
+    finally:
+        svc.drain()
+
+
+def test_queue_snapshot_per_class_depth_and_age():
+    svc = _quiet_service()  # packer never fires
+    svc.start()
+    try:
+        svc.submit(_doc(1))
+        svc.submit(_doc(2))
+        svc.submit(_doc(3, faults=["crash hosts=host1 start=0.2 "
+                                   "end=0.3"]))
+        time.sleep(0.05)
+        snap = svc.queue_snapshot()["packer"]
+        classes = snap["classes"]
+        assert len(classes) == 2
+        depths = sorted(c["depth"] for c in classes.values())
+        assert depths == [1, 2]
+        for c in classes.values():
+            assert c["oldest_wait_s"] >= 0.0
+        assert any("faults:none" in k for k in classes)
+    finally:
+        svc.drain()
+
+
+# ------------------------------------- per-class histograms + exemplars
+
+
+def test_per_class_histograms_render_with_exemplars():
+    m = ServeMetrics()
+    m.observe_class("queue_wait", "clsA", 1_000_000, rid="r000001",
+                    t_s=0.5)
+    m.observe_class("queue_wait", "clsA", 2_000_000, rid="r000007",
+                    t_s=0.9)
+    m.observe_class("beat_wall", "clsB", 5_000_000, rid="r000002",
+                    t_s=1.0)
+    scrape = m.render()
+    assert validate_openmetrics(scrape) == []
+    # each bucket's exemplar names the request that landed there
+    lines = [ln for ln in scrape.splitlines()
+             if ln.startswith("shadow_tpu_serve_queue_wait_ns_bucket"
+                              '{class="clsA"')
+             and "trace_id" in ln]
+    assert len(lines) == 2  # 1e6 and 2e6 ns are adjacent log2 buckets
+    assert any('# {trace_id="r000001"} 1000000 0.5' in ln
+               for ln in lines)
+    assert any('# {trace_id="r000007"} 2000000 0.9' in ln
+               for ln in lines)
+    assert 'shadow_tpu_serve_beat_wall_ns_count{class="clsB"} 1' \
+        in scrape
+    tot = m.totals()
+    assert tot['shadow_tpu_serve_queue_wait_ns_count{class="clsA"}'] == 2
+    assert tot['shadow_tpu_serve_queue_wait_ns_sum{class="clsA"}'] \
+        == 3_000_000
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        m.observe_class("nope", "clsA", 1)
+
+
+def test_validator_rejects_malformed_exemplars():
+    bad_placement = (
+        "# TYPE g gauge\n"
+        'g 1 # {trace_id="r1"} 5\n'
+        "# EOF\n")
+    probs = validate_openmetrics(bad_placement)
+    assert any("exemplar" in p for p in probs)
+
+    bad_syntax = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="+Inf"} 1 # trace_id=r1\n'
+        "h_count 1\nh_sum 5\n"
+        "# EOF\n")
+    probs = validate_openmetrics(bad_syntax)
+    assert any("exemplar" in p for p in probs)
+
+
+def test_check_openmetrics_cli_accepts_exemplars(tmp_path, capsys):
+    from shadow_tpu.tools.check_openmetrics import main as check_main
+
+    m = ServeMetrics()
+    m.observe_class("pack_wait", "c", 123_456, rid="r000003", t_s=0.1)
+    path = tmp_path / "scrape.txt"
+    path.write_text(m.render())
+    assert check_main([str(path)]) == 0
+    assert "ok:" in capsys.readouterr().err
+
+
+# ----------------------------------------------------- merged export
+
+
+def test_merged_export_valid_and_deterministic(tmp_path):
+    from shadow_tpu.tools.export_trace import export
+
+    ledger = _ledger_run(tmp_path, "exp")
+    out = tmp_path / "merged.json"
+    stats = export(None, str(out), ledger_path=ledger)
+    assert stats["serve_records"] > 0
+
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    assert {e["ph"] for e in evs} <= {"M", "i", "s", "f", "X"}
+    pids = {e["pid"] for e in evs}
+    assert {2, 3} <= pids  # serve wall + serve lanes (sim time)
+    # request tracks named by rid, lane rows by lane
+    names = {(e["pid"], e["args"]["name"]) for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert any(p == 2 and n.startswith("req r0") for p, n in names)
+    assert any(p == 3 and n.startswith("lane") for p, n in names)
+    # every beat's harvest flows from the wall span to its lane row
+    starts = [e for e in evs if e["ph"] == "s"]
+    ends = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == len(ends) > 0
+    by_id = {e["id"]: e for e in starts}
+    for e in ends:
+        assert e["id"] in by_id
+        assert e["pid"] == 3 and by_id[e["id"]]["pid"] == 2
+    assert doc["otherData"]["serve_ledger"] == ledger
+
+    out2 = tmp_path / "merged2.json"
+    export(None, str(out2), ledger_path=ledger)
+    assert out.read_bytes() == out2.read_bytes()
+
+
+def test_export_cli_requires_an_input(tmp_path, capsys):
+    import pytest
+
+    from shadow_tpu.tools.export_trace import main as export_main
+
+    with pytest.raises(SystemExit):
+        export_main([])
