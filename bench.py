@@ -1184,6 +1184,272 @@ def serve_chaos_worker():
                   file=sys.stderr, flush=True)
 
 
+def serve_elastic_worker():
+    """`bench.py --serve-elastic` (measure_all.sh serve_elastic stage,
+    BENCH_r11.json, docs/17-Serving.md "Elasticity"): live lane-batch
+    migration acceptance against a REAL `shadow_tpu serve --retry 2`
+    subprocess — the full cross-process story, wrapper included.
+
+    Wave 1 packs 8 requests at --max-lanes 8; `devloss:beat=2` makes
+    the child exit EXIT_PEER_LOST=77 with the beat-1 snapshot on disk.
+    The --retry wrapper halves --max-lanes to 4 (next_retry_argv) and
+    relaunches; `resume_pending_batch` migrates the 8-lane snapshot
+    into two 4-lane parts and finishes the batch under the ORIGINAL
+    request ids — the migration-MTTR numbers. Wave 2 runs 4 longer
+    requests at the shrunken width; `resize:beat=7,lanes=8` grows the
+    mesh back IN PROCESS mid-batch. Acceptance: every request of both
+    waves completes `done` with a summary that diffs EXACTLY
+    (tools/diff_runs, drift 0) against its solo_reference; wave-1
+    records carry resumed_from_beat in (0, beats); /healthz reports
+    degraded_capacity at max_lanes 4 after the shrink and full width 8
+    (no degraded flag) after the grow; /metrics carries
+    serve_migrations_total >= 2 plus the serve_mesh_generation gauge;
+    and a SIGTERM aimed at the WRAPPER is forwarded to the child,
+    which drains to exit 0 and yields the wrapper's retry report
+    (attempts 2, recoveries 1, one mttr_s sample)."""
+    import re as _re
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        _REPO, ".jax_cache_cpu")
+    _enable_compile_cache()
+
+    from shadow_tpu.serve.service import solo_reference
+    from shadow_tpu.tools.diff_runs import diff_files
+    from shadow_tpu.tools.serve_client import request_docs
+
+    work = tempfile.mkdtemp(prefix="shadow_tpu_serve_elastic_")
+    snap = os.path.join(work, "snap.npz")
+    err_path = os.path.join(work, "elastic.err")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SHADOW_TPU_SERVE_CHAOS"] = (
+        "devloss:beat=2;resize:beat=7,lanes=8")
+    # --max-lanes must be spelled out for next_retry_argv to halve it
+    argv = [sys.executable, "-m", "shadow_tpu", "serve", "--retry", "2",
+            "--port", "0", "--max-lanes", "8",
+            "--pack-deadline-ms", "600000", "--beat-windows", "2",
+            "--snapshot-beats", "1", "--snapshot-path", snap,
+            "--launch-retries", "1",
+            "--queue-file", os.path.join(work, "queue.json"),
+            "--diag-dir", work]
+
+    def _bases():
+        """Every base URL the (re)launched children have announced, in
+        order — with --port 0 each relaunch binds a fresh port, so the
+        LAST listening line is the live instance."""
+        try:
+            text = open(err_path).read()
+        except OSError:
+            return []
+        return [f"http://{h}:{p}" for h, p in
+                _re.findall(r"listening http://([\d.]+):(\d+)/", text)]
+
+    def _wait(proc, pred, what, budget=300):
+        deadline = time.monotonic() + max(min(_remaining(), budget), 60)
+        while time.monotonic() < deadline:
+            got = pred()
+            if got:
+                return got
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serve wrapper died rc={proc.returncode} before "
+                    f"{what}; stderr: {open(err_path).read()[-2000:]}")
+            time.sleep(0.1)
+        raise TimeoutError(f"serve_elastic: {what} never happened; "
+                           f"stderr: {open(err_path).read()[-2000:]}")
+
+    def _http(url, data=None):
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8")
+
+    def _submit(base, doc):
+        code, body = _http(base + "/submit",
+                           json.dumps(doc).encode("utf-8"))
+        if code != 200:
+            raise RuntimeError(f"/submit -> {code}: {body}")
+        return json.loads(body)["request_id"]
+
+    def _poll(proc, base, rids):
+        recs = {}
+        deadline = time.monotonic() + max(min(_remaining(), 600), 120)
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serve wrapper died rc={proc.returncode} mid-wave;"
+                    f" stderr: {open(err_path).read()[-2000:]}")
+            done = True
+            for rid in rids:
+                try:
+                    code, body = _http(f"{base}/result/{rid}")
+                except OSError:
+                    done = False  # restart window: refused / reset
+                    break
+                rec = json.loads(body)
+                recs[rid] = rec
+                if rec.get("status") not in ("done", "error", "timeout"):
+                    done = False
+            if done:
+                return recs
+            time.sleep(0.2)
+        raise TimeoutError(f"wave never finished: "
+                           f"{ {r: recs.get(r, {}).get('status') for r in rids} }")
+
+    def _diff_drift(rec, doc) -> int:
+        a = os.path.join(work, f"rec_{rec['request_id']}.json")
+        b = os.path.join(work, f"solo_{doc['seed']}.json")
+        with open(a, "w") as f:
+            json.dump(rec, f)
+        with open(b, "w") as f:
+            json.dump(solo_reference(doc), f)
+        return len(diff_files(a, b, rtol=0.1))
+
+    # one equivalence class per wave: full packs dispatch immediately
+    # despite the effectively-infinite pack deadline. Wave 2 runs 9
+    # beats (stop 0.9s at 2x50ms windows/beat) so resize:beat=7 fires
+    # mid-batch; wave 1's 5-beat requests never reach it.
+    wave_1 = request_docs(8, mix="plain", hosts=8, stop_s=0.5, seed0=921)
+    wave_2 = request_docs(4, mix="plain", hosts=8, stop_s=0.9, seed0=941)
+
+    out: dict = {}
+    proc = None
+    try:
+        # -- wave 1: 8-lane pack -> devloss@2 -> exit 77 -> wrapper
+        #    relaunch at 4 lanes -> split-migrate -> complete ----------
+        _stamp("serve_elastic: wave 1 (devloss -> shrink migration)")
+        err_f = open(err_path, "wb")
+        proc = subprocess.Popen(argv, cwd=_REPO, env=env,
+                                stdout=subprocess.DEVNULL, stderr=err_f)
+        base1 = _wait(proc, lambda: (_bases() or [None])[0],
+                      "first listening line")
+        rids_1 = [_submit(base1, d) for d in wave_1]
+        _wait(proc, lambda: "exited 77" in open(err_path).read(),
+              "devloss exit 77")
+        t_death = time.monotonic()
+        base2 = _wait(proc, lambda: (_bases()[1:2] or [None])[0],
+                      "relaunch listening line")
+        out["serve_elastic_relaunch_mttr_s"] = round(
+            time.monotonic() - t_death, 3)
+        recs = _poll(proc, base2, rids_1)
+        out["serve_elastic_migration_mttr_s"] = round(
+            time.monotonic() - t_death, 3)
+
+        resumed = [r.get("resumed_from_beat") for r in recs.values()]
+        drift_1 = sum(_diff_drift(recs[rid], d)
+                      for rid, d in zip(rids_1, wave_1)
+                      if recs[rid]["status"] == "done")
+        _, hz = _http(base2 + "/healthz")
+        hz = json.loads(hz)
+        out.update({
+            "serve_elastic_wave_1_done": sum(
+                1 for r in recs.values() if r["status"] == "done"),
+            "serve_elastic_resumed_from_beat": resumed[0],
+            "serve_elastic_drift_1": drift_1,
+            "serve_elastic_shrunk_lanes": hz.get("max_lanes"),
+            "serve_elastic_degraded": bool(hz.get("degraded_capacity")),
+        })
+        wave_1_ok = (
+            out["serve_elastic_wave_1_done"] == 8 and drift_1 == 0
+            and all(isinstance(b, int) and 0 < b < r["beats"]
+                    for b, r in zip(resumed, recs.values()))
+            and hz.get("max_lanes") == 4
+            and hz.get("degraded_capacity") is True
+            and hz.get("mesh_generation", 0) >= 1)
+
+        # -- wave 2: resize@7 grows the mesh back in process ----------
+        _stamp("serve_elastic: wave 2 (in-process resize grow)")
+        rids_2 = [_submit(base2, d) for d in wave_2]
+        recs_2 = _poll(proc, base2, rids_2)
+        drift_2 = sum(_diff_drift(recs_2[rid], d)
+                      for rid, d in zip(rids_2, wave_2)
+                      if recs_2[rid]["status"] == "done")
+        _, hz2 = _http(base2 + "/healthz")
+        hz2 = json.loads(hz2)
+        out.update({
+            "serve_elastic_wave_2_done": sum(
+                1 for r in recs_2.values() if r["status"] == "done"),
+            "serve_elastic_drift_2": drift_2,
+            "serve_elastic_grown_lanes": hz2.get("max_lanes"),
+        })
+        wave_2_ok = (
+            out["serve_elastic_wave_2_done"] == 4 and drift_2 == 0
+            and hz2.get("max_lanes") == 8
+            and not hz2.get("degraded_capacity"))
+
+        # counters from the live scrape: both migrations (the shrink
+        # split and the in-process grow) actually happened
+        _, metrics = _http(base2 + "/metrics")
+
+        def _counter(name):
+            m = _re.search(rf"^{name}_total ([\d.e+]+)$", metrics,
+                           _re.MULTILINE)
+            return int(float(m.group(1))) if m else -1
+
+        def _gauge(name):
+            m = _re.search(rf"^{name} ([\d.e+]+)$", metrics,
+                           _re.MULTILINE)
+            return int(float(m.group(1))) if m else -1
+
+        out.update({
+            "serve_elastic_migrations": _counter(
+                "shadow_tpu_serve_migrations"),
+            "serve_elastic_resumes": _counter("shadow_tpu_serve_resumes"),
+            "serve_elastic_mesh_generation": _gauge(
+                "shadow_tpu_serve_mesh_generation"),
+        })
+
+        # SIGTERM the WRAPPER: run_with_retry forwards to the child's
+        # process group, the child drains, the wrapper reports
+        proc.send_signal(signal.SIGTERM)
+        out["serve_elastic_drain_rc"] = proc.wait(timeout=60)
+        proc = None
+        m = _re.search(r"shadow_tpu: retry report (\{.*\})",
+                       open(err_path).read())
+        report = json.loads(m.group(1)) if m else {}
+        out["serve_elastic_retry_report"] = report
+
+        ok = bool(
+            wave_1_ok and wave_2_ok
+            and out["serve_elastic_migrations"] >= 2
+            and out["serve_elastic_resumes"] >= 1
+            and out["serve_elastic_mesh_generation"] >= 1
+            and out["serve_elastic_drain_rc"] == 0
+            and report.get("attempts") == 2
+            and report.get("recoveries") == 1
+            and report.get("exit_history", [None])[0] == 77
+            and len(report.get("mttr_s", [])) == 1)
+        out["serve_elastic_ok"] = ok
+        print(json.dumps(out), flush=True)
+        print(f"serve_elastic: relaunch MTTR "
+              f"{out['serve_elastic_relaunch_mttr_s']}s, migration wall "
+              f"{out['serve_elastic_migration_mttr_s']}s, resumed from "
+              f"beat {out['serve_elastic_resumed_from_beat']}, "
+              f"{out['serve_elastic_migrations']} migrations, drift "
+              f"{drift_1}+{drift_2} -> {'ok' if ok else 'FAIL'}",
+              file=sys.stderr, flush=True)
+        if not ok:
+            sys.exit(1)
+        shutil.rmtree(work, ignore_errors=True)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        if os.path.isdir(work):  # kept on failure, for the stderr tail
+            print(f"serve_elastic: artifacts kept at {work}",
+                  file=sys.stderr, flush=True)
+
+
 def multichip_worker():
     """Weak-scaling PHOLD over an 8-device mesh — MULTICHIP_r*.json
     carries data now, not just a smoke bit.
@@ -1977,6 +2243,7 @@ def main():
                      ("--fleet-smoke", fleet_smoke_worker),
                      ("--serve-smoke", serve_smoke_worker),
                      ("--serve-chaos", serve_chaos_worker),
+                     ("--serve-elastic", serve_elastic_worker),
                      ("--perf-smoke", perf_smoke),
                      ("--multichip-worker", multichip_worker),
                      ("--chaos-worker", chaos_worker),

@@ -403,6 +403,21 @@ print(json.dumps({
 }))
 PYEOF
 echo "=== serve_trace exit=$? $(date +%H:%M:%S)" >> "$S"
+# serve elasticity: live lane-batch migration acceptance
+# (docs/17-Serving.md "Elasticity") against a real
+# `shadow_tpu serve --retry 2` subprocess. Wave 1: 8 requests packed at
+# --max-lanes 8, devloss:beat=2 exits the child 77 (peer-lost), the
+# retry wrapper relaunches at the halved width and resume_pending_batch
+# splits the 8-lane snapshot into two 4-lane parts that finish under
+# the ORIGINAL request ids (migration MTTR). Wave 2: 4 longer requests
+# at the shrunken width, resize:beat=7,lanes=8 grows the mesh back in
+# process mid-batch. Gates: both waves drift-0 vs solo_reference via
+# tools/diff_runs, /healthz walks the degraded->restored capacity arc,
+# /metrics carries serve_migrations_total >= 2 and the
+# serve_mesh_generation gauge, and one SIGTERM at the wrapper drains
+# child + wrapper to exit 0 with the retry report (attempts=2,
+# recoveries=1, mttr_s) on stderr.
+run serve_elastic 900 --serve-elastic JAX_PLATFORMS=cpu BENCH_BUDGET_S=840
 # perf smoke: a small CPU-backend PHOLD, a small tgen TCP workload
 # under the frontier drain, and an 8-lane PHOLD fleet, each against its
 # checked-in PERF_FLOOR.json floor — fails (exit 1) when any of the

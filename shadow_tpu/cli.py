@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -583,10 +584,20 @@ def _run_serve(args) -> int:
     (docs/17-Serving.md). The main thread owns the signal plane; the
     launch worker and the HTTP handler threads do the work. SIGTERM /
     SIGINT trigger the graceful drain — finish the launch in flight,
-    persist the pending queue to --queue-file, exit 0."""
+    persist the pending queue to --queue-file, exit 0. SIGHUP is the
+    operator mesh resize: it reads the new lane count from
+    `<snapshot-path>.resize` and migrates the in-flight batch at the
+    next beat boundary (docs/17-Serving.md "Elasticity")."""
+    import signal as _signal
+
     from shadow_tpu.runtime.supervisor import Supervisor
     from shadow_tpu.serve.http import ServeServer
     from shadow_tpu.serve.service import SimService
+
+    # a relaunch under `--retry` (the elastic outer loop) seeds the mesh
+    # generation, so /healthz reports the churn from the first beat
+    _attempt = os.environ.get("SHADOW_TPU_RETRY_ATTEMPT")
+    generation = int(_attempt) if _attempt and _attempt.isdigit() else 0
 
     tracer = None
     if args.trace_requests > 0 or args.ledger_file:
@@ -613,7 +624,29 @@ def _run_serve(args) -> int:
         degraded_after=args.degraded_after,
         diag_dir=args.diag_dir,
         tracer=tracer,
+        generation=generation,
     )
+
+    def _on_sighup(_signum, _frame):
+        ctl = (args.snapshot_path or "shadow_tpu.serve") + ".resize"
+        try:
+            with open(ctl) as f:
+                lanes = int(f.read().strip())
+            os.remove(ctl)
+        except (OSError, ValueError) as e:
+            print(f"serve: SIGHUP resize ignored — no usable lane "
+                  f"count in {ctl!r} ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
+            return
+        print(f"serve: SIGHUP resize -> {lanes} lane(s)",
+              file=sys.stderr, flush=True)
+        try:
+            svc.resize(lanes)
+        except ValueError as e:
+            print(f"serve: SIGHUP resize rejected: {e}",
+                  file=sys.stderr, flush=True)
+
+    _signal.signal(_signal.SIGHUP, _on_sighup)
     with Supervisor(label="shadow_tpu-serve") as sup:
         # resume BEFORE reloading the drained queue: the crashed batch
         # must reach the worker ahead of any re-packed queue traffic,
@@ -651,7 +684,10 @@ def main(argv=None) -> int:
         # driver as a child in its own process group; on stall (75),
         # peer-lost (77), or a signal death, reap the child's whole
         # group, back off exponentially, and relaunch with --resume auto
-        # — on a halved --mesh after a lost peer
+        # — on a halved --mesh after a lost peer. A `serve` child is
+        # elastic through its own flags instead: no --resume, a halved
+        # --max-lanes on peer-lost, and --snapshot-path/--queue-file
+        # ride along so resume_pending_batch migrates the batch
         from shadow_tpu.runtime import run_with_retry
 
         child = [sys.executable, "-m", "shadow_tpu"] + _strip_retry_flags(

@@ -354,7 +354,13 @@ class GraphNetwork:
 
     @property
     def has_jitter(self) -> bool:
-        return bool(jnp.any(self.jit > 0))
+        # host-side numpy on purpose: this property is consulted from
+        # inside traced code (`Engine.replace` during the fleet's
+        # per-lane latency bind), where a staged `jnp.any` would be a
+        # tracer and `bool()` of it a TracerBoolConversionError. The
+        # routing tables are trace-time constants, so numpy stays
+        # concrete there.
+        return bool(np.any(np.asarray(self.jit) > 0))
 
     @property
     def min_latency_ns(self) -> int:

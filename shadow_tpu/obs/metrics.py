@@ -510,6 +510,13 @@ SERVE_SPECS: tuple[MetricSpec, ...] = (
     MetricSpec(_P + "serve_degraded", "gauge",
                "1 while repeated launch failures hold /submit at 503.",
                "host-side: _run_batch failure streak"),
+    MetricSpec(_P + "serve_migrations", "counter",
+               "Lane batches migrated across a device loss or resize.",
+               "host-side: elastic snapshot reshard"),
+    MetricSpec(_P + "serve_mesh_generation", "gauge",
+               "Mesh generation (0 = as launched; bumps per migration "
+               "or resize).",
+               "host-side: elastic snapshot reshard"),
 )
 
 _SERVE_HIST = _P + "serve_request_latency_ns"

@@ -649,6 +649,74 @@ def inert_lane_state(state):
     return dataclasses.replace(state, queues=q)
 
 
+def lane_reshard(state, new_lanes: int) -> list:
+    """Split an `[L, ...]` lane-stacked state tree into `L // new_lanes`
+    sub-trees of `new_lanes` lanes each, slicing the leading (LANE) axis
+    of every leaf. The serving plane's elastic migration uses this to
+    turn one snapshot written at 8 lanes into two 4-lane resumable
+    batches after a device loss halves the mesh
+    (docs/17-Serving.md "Elasticity").
+
+    Works on any pytree whose leaves all lead with the same lane axis —
+    live fleet state, a loaded checkpoint tree, or the raw
+    {leaf_path: array} dict of `utils.checkpoint.load_checkpoint_raw`.
+    Refuses loudly (leaf named) on scalar leaves, leaves that disagree
+    about L, and lane counts that do not divide evenly — a silent
+    truncation here would drop in-flight requests.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    if not leaves:
+        raise ValueError("lane_reshard: empty state tree")
+    lanes = -1
+    for path, leaf in leaves:
+        shape = np.shape(leaf)
+        if not shape:
+            raise ValueError(
+                "lane_reshard: leaf "
+                f"{jax.tree_util.keystr(path)!r} is a scalar — every "
+                "leaf of a lane-stacked tree must lead with the LANE "
+                "axis"
+            )
+        if lanes < 0:
+            lanes = int(shape[0])
+        elif int(shape[0]) != lanes:
+            raise ValueError(
+                "lane_reshard: leaf "
+                f"{jax.tree_util.keystr(path)!r} has leading dim "
+                f"{int(shape[0])} but earlier leaves have {lanes} — "
+                "this tree is not lane-stacked along a shared axis"
+            )
+    if new_lanes <= 0 or lanes % new_lanes != 0:
+        raise ValueError(
+            f"lane_reshard: cannot split {lanes} lanes into parts of "
+            f"{new_lanes} — the part size must divide the lane count "
+            "evenly (a remainder would strand in-flight lanes)"
+        )
+    parts = []
+    for j in range(lanes // new_lanes):
+        lo, hi = j * new_lanes, (j + 1) * new_lanes
+        parts.append(jax.tree_util.tree_unflatten(
+            treedef, [leaf[lo:hi] for _, leaf in leaves]
+        ))
+    return parts
+
+
+def lane_merge(states: list):
+    """Concatenate lane-stacked state trees along the LANE axis — the
+    inverse of `lane_reshard`, used when a resize *grows* the mesh and
+    a small snapshot must pad up to the new lane count with inert
+    template lanes. Leaves come back as host numpy (the caller adopts
+    them through `Fleet.adopt_state`, which re-copies onto device)."""
+    if not states:
+        raise ValueError("lane_merge: no states to merge")
+    if len(states) == 1:
+        return states[0]
+    return jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+        *states,
+    )
+
+
 def _scale_nic(state, scale: float):
     """Scale a lane's NIC rates in its initial state (bandwidth knob)."""
     hosts = state.hosts

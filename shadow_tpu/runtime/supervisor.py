@@ -395,9 +395,20 @@ def next_retry_argv(argv: list[str], rc: int, *, mesh_flag: str = "--mesh",
     checkpoint when there is one, but a worker that died before its
     first checkpoint simply restarts from zero) and, when `shrink` (a
     peer was lost — its devices are gone), halve the mesh so the
-    survivors can host the whole run."""
+    survivors can host the whole run.
+
+    A `serve` worker is elastic the same way but through different
+    flags: its resume path is `resume_pending_batch` (driven by
+    `--snapshot-path`/`--queue-file`, which ride along in the argv
+    untouched — never `--resume`, which serve does not accept), and its
+    mesh is `--max-lanes` — a peer-lost exit halves the lane count so
+    the relaunch compiles for the surviving devices and the snapshot
+    migrator splits the in-flight batch to fit
+    (docs/17-Serving.md "Elasticity")."""
     argv = list(argv)
-    if "--resume" not in argv and not any(
+    if "serve" in argv:
+        mesh_flag = "--max-lanes"
+    elif "--resume" not in argv and not any(
             a.startswith("--resume=") for a in argv):
         argv += ["--resume", "auto-if-any"]
     if shrink:
@@ -436,11 +447,50 @@ def run_with_retry(argv: list[str], *, retries: int,
     stderr output, or its exit when it stays silent). `on_spawn(proc)`
     is called per attempt (the chaos harness uses it to find its
     victim). Deliberately jax-free, like the rest of this module.
+
+    Because each child runs in its own session, a SIGTERM/SIGINT/SIGHUP
+    delivered to the supervisor would otherwise kill only the
+    supervisor and orphan the worker — losing both the graceful drain
+    (serve flushes its queue file on SIGTERM) and the retry report. So
+    while a child is alive those signals are forwarded to its process
+    group and the supervisor keeps waiting for the child's own exit.
     """
     report: dict = {"attempts": 0, "recoveries": 0, "exit_code": None,
                     "exit_history": [], "mttr_s": []}
     argv = list(argv)
     fail_t: float | None = None
+    current: list = [None]  # the live child, for the signal forwarders
+
+    def _forward(signum, frame):
+        proc = current[0]
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signum)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+
+    old_handlers: dict = {}
+    for signum in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        try:
+            old_handlers[signum] = signal.signal(signum, _forward)
+        except (ValueError, OSError):  # non-main thread, or unsupported
+            pass
+    try:
+        return _retry_loop(argv, report, fail_t, current,
+                           retries=retries, backoff_s=backoff_s,
+                           mesh_flag=mesh_flag, on_spawn=on_spawn,
+                           _sleep=_sleep, _popen=_popen)
+    finally:
+        for signum, handler in old_handlers.items():
+            try:
+                signal.signal(signum, handler)
+            except (ValueError, OSError):
+                pass
+
+
+def _retry_loop(argv: list[str], report: dict, fail_t: float | None,
+                current: list, *, retries: int, backoff_s: float,
+                mesh_flag: str, on_spawn, _sleep, _popen) -> dict:
     for attempt in range(retries + 1):
         report["attempts"] += 1
         first_out: list = [None]
@@ -452,6 +502,7 @@ def run_with_retry(argv: list[str], *, retries: int,
             env["SHADOW_TPU_RETRY_ATTEMPT"] = str(attempt)
         proc = _popen(argv, start_new_session=True, stderr=subprocess.PIPE,
                       env=env)
+        current[0] = proc
 
         def _tee(stream, mark):
             for line in iter(stream.readline, b""):
@@ -468,6 +519,7 @@ def run_with_retry(argv: list[str], *, retries: int,
         if on_spawn is not None:
             on_spawn(proc)
         rc = proc.wait()
+        current[0] = None
         if tee is not None:
             tee.join(timeout=5.0)
         if fail_t is not None:

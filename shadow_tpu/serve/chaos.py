@@ -11,6 +11,13 @@ form `kind:key=value,key=value`:
                           watchdog without corrupting device state)
     kill:beat=K           one-shot SIGKILL of the serve process at the
                           start of beat K (marker written first)
+    devloss:beat=K        one-shot DeviceLost at the start of beat K —
+                          EXIT_PEER_LOST=77 semantics: the service
+                          treats it as a vanished device, not a
+                          retryable launch failure
+    resize:beat=K,lanes=M one-shot ResizeRequested(M) at the start of
+                          beat K — the operator-SIGHUP mesh resize,
+                          injected deterministically
 
 "One-shot" must survive a SIGKILL + relaunch — the whole point of
 `kill` is to test the restart path, and the restarted process re-reads
@@ -38,10 +45,29 @@ class ChaosInjected(RuntimeError):
     """The exception raised by the `raise` and `poison` injectors."""
 
 
+class DeviceLost(RuntimeError):
+    """A device vanished mid-launch (the `devloss` injector, or a real
+    backend peer-lost failure classified by the service). Distinct from
+    ChaosInjected because the service must NOT retry in place — the
+    compiled shape is gone; it exits EXIT_PEER_LOST=77 so the outer
+    retry loop relaunches at a smaller mesh."""
+
+
+class ResizeRequested(RuntimeError):
+    """An operator asked for a new lane count mid-launch (the `resize`
+    injector, or SIGHUP with a `.resize` control file). Carries the
+    target in `.lanes`; the beat loop converts it into an in-process
+    snapshot + migration instead of a failure."""
+
+    def __init__(self, lanes: int):
+        super().__init__(f"resize to {lanes} lanes requested")
+        self.lanes = int(lanes)
+
+
 def _parse_token(token: str) -> dict:
     kind, _, rest = token.partition(":")
     kind = kind.strip()
-    if kind not in ("raise", "poison", "wedge", "kill"):
+    if kind not in ("raise", "poison", "wedge", "kill", "devloss", "resize"):
         raise ValueError(f"serve-chaos: unknown injector {kind!r} in {token!r}")
     inj: dict = {"kind": kind, "token": token}
     for part in filter(None, (p.strip() for p in rest.split(","))):
@@ -55,7 +81,8 @@ def _parse_token(token: str) -> dict:
                 f"serve-chaos: non-numeric value {v!r} in {token!r}"
             ) from None
     need = {"raise": ("beat",), "poison": ("seed",),
-            "wedge": ("beat", "secs"), "kill": ("beat",)}[kind]
+            "wedge": ("beat", "secs"), "kill": ("beat",),
+            "devloss": ("beat",), "resize": ("beat", "lanes")}[kind]
     for k in need:
         if k not in inj:
             raise ValueError(f"serve-chaos: {kind!r} needs {k}= in {token!r}")
@@ -118,6 +145,15 @@ class ServeChaos:
                 if kind == "kill" and beat == inj["beat"] and self._once(inj):
                     self._note(kind)
                     os.kill(os.getpid(), signal.SIGKILL)
+                if (kind == "devloss" and beat == inj["beat"]
+                        and self._once(inj)):
+                    self._note(kind)
+                    raise DeviceLost(
+                        f"serve-chaos: injected device loss at beat {beat}")
+                if (kind == "resize" and beat == inj["beat"]
+                        and self._once(inj)):
+                    self._note(kind)
+                    raise ResizeRequested(inj["lanes"])
             elif site == "fetch":
                 if kind == "wedge" and beat == inj["beat"] and self._once(inj):
                     self._note(kind)

@@ -70,6 +70,7 @@ from collections import OrderedDict
 from typing import Any, Callable
 
 from shadow_tpu.serve.cache import ProgramCache
+from shadow_tpu.serve.chaos import DeviceLost, ResizeRequested
 from shadow_tpu.serve.packer import (
     ClassKey,
     LanePacker,
@@ -124,6 +125,93 @@ def _phold_build(params: dict, seed: int):
     return eng, init(), [f"host{i}" for i in range(n)]
 
 
+# Config-driven scenarios (tgen / tor / bitcoin) build through the
+# example-config generators + `build_simulation`. Host-id orderings in
+# `hosts_of` mirror the generators' declaration order EXACTLY (locality
+# reordering is off on this path), because fault-glob signatures are
+# computed against these names at submit time without building.
+# Parameter defaults mirror the generators' own defaults verbatim —
+# `hosts_of` and `build` must agree on them or the fault signature and
+# the compiled pad would disagree about the host set.
+
+
+def _config_sim(xml: str, seed: int, capacity):
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.sim import build_simulation
+
+    sim = build_simulation(
+        parse_config(xml), seed=seed,
+        capacity=int(capacity) if capacity is not None else None,
+    )
+    return sim.engine, sim.state0, sim.names
+
+
+def _tgen_hosts(params: dict):
+    n = int(params.get("n_pairs", 64))
+    return ([f"srv{i}" for i in range(n)]
+            + [f"cli{i}" for i in range(n)], 2 * n)
+
+
+def _tgen_build(params: dict, seed: int):
+    from shadow_tpu.examples import tgen_example
+
+    p = dict(params)
+    cap = p.pop("capacity", None)
+    xml = tgen_example(
+        n_pairs=int(p.pop("n_pairs", 64)),
+        sendsize=str(p.pop("sendsize", "16KiB")),
+        recvsize=str(p.pop("recvsize", "64KiB")),
+        count=int(p.pop("count", 4)),
+    )
+    return _config_sim(xml, seed, cap)
+
+
+def _tor_hosts(params: dict):
+    k = int(params.get("n_relays_per_class", 10))
+    s = int(params.get("n_servers", 10))
+    c = int(params.get("n_clients", 950))
+    names = ([f"{kl}{i}" for kl in ("guard", "middle", "exit")
+              for i in range(k)]
+             + [f"web{i}" for i in range(s)]
+             + [f"torclient{i}" for i in range(c)])
+    return names, len(names)
+
+
+def _tor_build(params: dict, seed: int):
+    from shadow_tpu.examples import tor_example
+
+    p = dict(params)
+    cap = p.pop("capacity", None)
+    xml = tor_example(
+        n_relays_per_class=int(p.pop("n_relays_per_class", 10)),
+        n_clients=int(p.pop("n_clients", 950)),
+        n_servers=int(p.pop("n_servers", 10)),
+        filesize=str(p.pop("filesize", "320KiB")),
+        count=int(p.pop("count", 5)),
+        relay_cpu_ghz=float(p.pop("relay_cpu_ghz", 0.0)),
+    )
+    return _config_sim(xml, seed, cap)
+
+
+def _bitcoin_hosts(params: dict):
+    n = int(params.get("n_nodes", 5000))
+    return ["miner0"] + [f"btc{i}" for i in range(1, n)], n
+
+
+def _bitcoin_build(params: dict, seed: int):
+    from shadow_tpu.examples import bitcoin_example
+
+    p = dict(params)
+    cap = p.pop("capacity", None)
+    xml = bitcoin_example(
+        n_nodes=int(p.pop("n_nodes", 5000)),
+        blocks=int(p.pop("blocks", 3)),
+        blocksize=str(p.pop("blocksize", "512KiB")),
+        interval=int(p.pop("interval", 60)),
+    )
+    return _config_sim(xml, seed, cap)
+
+
 SCENARIOS: dict[str, Scenario] = {
     "phold": Scenario(
         name="phold",
@@ -134,6 +222,31 @@ SCENARIOS: dict[str, Scenario] = {
         }),
         build=_phold_build,
         hosts_of=_phold_hosts,
+    ),
+    "tgen": Scenario(
+        name="tgen",
+        param_names=frozenset({
+            "n_pairs", "sendsize", "recvsize", "count", "capacity",
+        }),
+        build=_tgen_build,
+        hosts_of=_tgen_hosts,
+    ),
+    "tor": Scenario(
+        name="tor",
+        param_names=frozenset({
+            "n_relays_per_class", "n_clients", "n_servers", "filesize",
+            "count", "relay_cpu_ghz", "capacity",
+        }),
+        build=_tor_build,
+        hosts_of=_tor_hosts,
+    ),
+    "bitcoin": Scenario(
+        name="bitcoin",
+        param_names=frozenset({
+            "n_nodes", "blocks", "blocksize", "interval", "capacity",
+        }),
+        build=_bitcoin_build,
+        hosts_of=_bitcoin_hosts,
     ),
 }
 
@@ -250,7 +363,9 @@ class SimService:
                  diag_dir: str = ".",
                  chaos=None,
                  tracer=None,
-                 watchdog_exit=None):
+                 watchdog_exit=None,
+                 generation: int = 0,
+                 peer_lost_exit=None):
         if max_lanes < 1:
             raise ValueError(f"max_lanes must be >= 1, got {max_lanes}")
         from shadow_tpu.obs.metrics import ServeMetrics
@@ -292,7 +407,24 @@ class SimService:
         self._fail_streak = 0
         self._degraded = False
         self._degraded_cause: str | None = None
-        self._resume: tuple | None = None  # (key, reqs) handed to worker
+        # batches handed to the worker ahead of packer traffic:
+        # (key, reqs, snapshot_path) — resume_pending_batch and the
+        # in-flight migrator both append here
+        self._resume: list[tuple] = []
+
+        # -- elastic serving (docs/17-Serving.md "Elasticity"): the mesh
+        # generation starts at 0 (as launched) and bumps on every
+        # migration or resize; a relaunched process seeds it from the
+        # retry attempt so /healthz reports the churn. `peer_lost_exit`
+        # is injectable for tests (default: os._exit with
+        # EXIT_PEER_LOST, the real device-loss escape hatch).
+        self._generation = max(int(generation), 0)
+        self._peak_lanes = self.max_lanes
+        self._resize_to: int | None = None
+        self._peer_lost_exit = (peer_lost_exit if peer_lost_exit
+                                is not None else os._exit)
+        if self._generation:
+            self.metrics.set("serve_mesh_generation", self._generation)
 
         if chaos is None:
             from shadow_tpu.serve import chaos as chaos_mod
@@ -398,7 +530,14 @@ class SimService:
 
     def health(self) -> dict:
         """/healthz body: {"status": "ok"|"draining"|"degraded"} plus
-        the failure cause while degraded. Only "ok" maps to HTTP 200."""
+        the failure cause while degraded. Only "ok" maps to HTTP 200.
+
+        After an elastic event the "ok" body additionally carries the
+        mesh generation and current capacity — and, while the lane
+        count sits below the peak this process has served at,
+        `degraded_capacity` so an orchestrator knows to restore the
+        mesh. Generation 0 keeps the body byte-identical to the
+        pre-elastic one (zero-cost discipline, pinned in tests)."""
         with self._cond:
             if self._stopping:
                 return {"status": "draining"}
@@ -406,7 +545,49 @@ class SimService:
                 return {"status": "degraded",
                         "cause": self._degraded_cause,
                         "fail_streak": self._fail_streak}
+            if self._generation or self.max_lanes < self._peak_lanes:
+                out = {"status": "ok",
+                       "mesh_generation": self._generation,
+                       "max_lanes": self.max_lanes}
+                if self.max_lanes < self._peak_lanes:
+                    out["degraded_capacity"] = True
+                    out["peak_lanes"] = self._peak_lanes
+                return out
         return {"status": "ok"}
+
+    # -- elastic resize --------------------------------------------------
+
+    def resize(self, lanes: int) -> None:
+        """Operator mesh resize (the SIGHUP path): applied between
+        batches when the worker is idle, or converted into a
+        beat-boundary snapshot + migration when a launch is in flight —
+        requests keep their original rids either way."""
+        if int(lanes) < 1:
+            raise ValueError(f"resize: lanes must be >= 1, got {lanes}")
+        with self._cond:
+            self._resize_to = int(lanes)
+            self._cond.notify()
+
+    def _bump_generation_locked(self, why: str) -> None:
+        self._generation += 1
+        self.metrics.set("serve_mesh_generation", self._generation)
+        print(f"serve: mesh generation -> {self._generation} ({why})",
+              file=sys.stderr, flush=True)
+
+    def _apply_resize_locked(self, lanes: int) -> None:
+        """Change the lane count (caller holds `_cond`): the packer
+        fills to the new width, the next cache get compiles at it (the
+        generation bump keys the cache entry), and the peak-capacity
+        watermark feeds `degraded_capacity` in /healthz."""
+        lanes = int(lanes)
+        self._resize_to = None
+        if lanes < 1 or lanes == self.max_lanes:
+            return
+        old = self.max_lanes
+        self.max_lanes = lanes
+        self.packer.max_lanes = lanes
+        self._peak_lanes = max(self._peak_lanes, lanes)
+        self._bump_generation_locked(f"resize {old} -> {lanes} lanes")
 
     # -- result retention ------------------------------------------------
 
@@ -504,25 +685,9 @@ class SimService:
         os.remove(self.queue_file)
         return n
 
-    def resume_pending_batch(self) -> int:
-        """Crash recovery: if the snapshot file carries a v7 batch
-        manifest, re-register its requests under their ORIGINAL rids and
-        hand the batch to the worker ahead of packer traffic — `_launch`
-        then reloads the state tree and continues from the snapshotted
-        beat. Returns the number of resumed requests (0 if none)."""
-        path = self.snapshot_path
-        if not path or not os.path.exists(path):
-            return 0
-        from shadow_tpu.utils.checkpoint import read_header_info
-
-        try:
-            serve = read_header_info(path).get("serve")
-        except ValueError as e:
-            print(f"serve: ignoring unreadable snapshot {path!r}: {e}",
-                  file=sys.stderr, flush=True)
-            return 0
-        if not serve:
-            return 0
+    def _entries_from_manifest(self, serve: dict, path: str):
+        """(key, reqs, snapshot_path) for the worker, or None when the
+        manifest no longer parses under the current schema."""
         try:
             reqs = []
             for rid, seq, d in zip(serve["rids"], serve["seqs"],
@@ -531,33 +696,213 @@ class SimService:
                 validate_request(req)
                 reqs.append(req)
             if not reqs:
-                return 0
+                return None
             key = request_class(reqs[0])
-        except Exception as e:  # noqa: BLE001 - a stale manifest must not kill startup
-            print(
-                f"serve: snapshot {path!r} manifest no longer parses "
-                f"({type(e).__name__}: {e}); leaving it for triage",
-                file=sys.stderr, flush=True,
-            )
+        except Exception:  # noqa: BLE001 - a stale manifest must not kill startup
+            return None
+        return (key, reqs, path)
+
+    def resume_pending_batch(self) -> int:
+        """Crash recovery: scan the snapshot path AND any `.part*`
+        migration outputs next to it; every file carrying a v7 batch
+        manifest re-registers its requests under their ORIGINAL rids
+        and hands the batch to the worker ahead of packer traffic —
+        `_launch` then reloads the state tree and continues from the
+        snapshotted beat. A snapshot written at a DIFFERENT lane count
+        (the writer died and the retry loop halved --max-lanes) is
+        migrated first: its `[L, ...]` state tree is resharded along
+        the lane axis into per-batch part files that fit the current
+        mesh (docs/17-Serving.md "Elasticity"). Returns the number of
+        resumed requests (0 if none)."""
+        base = self.snapshot_path
+        if not base:
             return 0
+        import glob as _glob
+
+        from shadow_tpu.utils.checkpoint import read_header_info
+
+        cands = ([base] if os.path.exists(base) else []) + sorted(
+            p for p in _glob.glob(base + ".part*")
+            if not p.endswith(".tmp"))
+        entries: list[tuple] = []
+        migrated = False
+        for path in cands:
+            try:
+                serve = read_header_info(path).get("serve")
+            except ValueError as e:
+                print(
+                    f"serve: ignoring unreadable snapshot {path!r}: {e}",
+                    file=sys.stderr, flush=True)
+                continue
+            if not serve:
+                continue
+            writer_lanes = int(serve.get("max_lanes") or 0)
+            if writer_lanes != self.max_lanes:
+                self._peak_lanes = max(self._peak_lanes, writer_lanes)
+                got = self._migrate_snapshot(path)
+                if got:
+                    migrated = True
+                    entries.extend(got)
+                continue
+            ent = self._entries_from_manifest(serve, path)
+            if ent is None:
+                print(
+                    f"serve: snapshot {path!r} manifest no longer "
+                    "parses; leaving it for triage",
+                    file=sys.stderr, flush=True)
+                continue
+            entries.append(ent)
+        if not entries:
+            return 0
+        n = 0
         now = self._clock()
         with self._cond:
-            self._seq = max(self._seq, max(r.seq for r in reqs) + 1)
-            for r in reqs:
-                self._results[r.rid] = {
-                    "request_id": r.rid, "status": "queued",
-                    "class": str(key),
-                }
-                self._submit_t[r.rid] = now
-            self._resume = (key, reqs)
+            top = max(r.seq for _k, rs, _p in entries for r in rs)
+            self._seq = max(self._seq, top + 1)
+            for key, rs, _p in entries:
+                for r in rs:
+                    self._results[r.rid] = {
+                        "request_id": r.rid, "status": "queued",
+                        "class": str(key),
+                    }
+                    self._submit_t[r.rid] = now
+                    n += 1
+            self._resume.extend(entries)
+            if migrated:
+                self._bump_generation_locked(
+                    "snapshot migrated to the relaunched mesh")
             self._cond.notify()
-        self.metrics.inc("serve_requests", len(reqs))
+        self.metrics.inc("serve_requests", n)
         print(
-            f"serve: resuming {len(reqs)} request(s) from snapshot "
-            f"{path!r} (beat {serve.get('beats_done', '?')})",
+            f"serve: resuming {n} request(s) across {len(entries)} "
+            f"batch(es) from {base!r}",
             file=sys.stderr, flush=True,
         )
-        return len(reqs)
+        return n
+
+    def _migrate_snapshot(self, path: str) -> list[tuple]:
+        """Reshard one snapshot file to the current lane count, at the
+        FILE level — no fleet of the old shape exists anymore, so the
+        raw `[L, ...]` leaves are sliced along the lane axis
+        (`runtime.fleet.lane_reshard`) and written back under the SAME
+        leaf-path keys (`save_checkpoint_raw`), one part file per
+        sub-batch, each with its own chunked manifest. Growing writes a
+        single part that records `state_lanes` so the loader pads it up
+        with inert template lanes. Returns the worker entries; refuses
+        loudly — file left for triage — on a lane count that does not
+        divide or a manifest that no longer parses."""
+        import numpy as np
+
+        from shadow_tpu.runtime.fleet import lane_reshard
+        from shadow_tpu.utils.checkpoint import (
+            load_checkpoint_raw,
+            save_checkpoint_raw,
+        )
+
+        new_L = self.max_lanes
+        try:
+            header, by_path = load_checkpoint_raw(path)
+            serve = dict(header.get("serve") or {})
+            paths = header["paths"]
+            arrs = [by_path[p] for p in paths]
+            old_L = int(np.shape(arrs[0])[0])
+            rids = list(serve["rids"])
+            chunks: list[tuple[dict, dict]] = []
+            if old_L <= new_L:
+                # grow (or same size under a changed max_lanes): one
+                # part, state stays at old_L lanes; the loader merges
+                # inert template lanes on top (requests <= old_L
+                # always, so the pad lanes never step)
+                manifest = dict(serve)
+                manifest["max_lanes"] = new_L
+                if old_L != new_L:
+                    manifest["state_lanes"] = old_L
+                else:
+                    manifest.pop("state_lanes", None)
+                chunks.append((dict(zip(paths, arrs)), manifest))
+            else:
+                parts = lane_reshard(arrs, new_L)
+                for j, part in enumerate(parts):
+                    lo, hi = j * new_L, (j + 1) * new_L
+                    if not rids[lo:hi]:
+                        continue  # trailing all-pad lanes
+                    manifest = dict(serve)
+                    manifest["max_lanes"] = new_L
+                    manifest.pop("state_lanes", None)
+                    for k in ("rids", "seqs", "docs"):
+                        manifest[k] = list(serve[k])[lo:hi]
+                    if "stops" in serve:
+                        manifest["stops"] = list(serve["stops"])[lo:hi]
+                    chunks.append((dict(zip(paths, part)), manifest))
+        except (ValueError, KeyError) as e:
+            print(
+                f"serve: cannot migrate snapshot {path!r} to {new_L} "
+                f"lane(s) ({type(e).__name__}: {e}); leaving it for "
+                "triage", file=sys.stderr, flush=True)
+            return []
+        staged = []
+        for j, (leaves, manifest) in enumerate(chunks):
+            part_path = f"{path}.part{j}"
+            k = 0
+            while os.path.exists(part_path):  # never clobber a pending part
+                k += 1
+                part_path = f"{path}.part{j}.m{k}"
+            ent = self._entries_from_manifest(manifest, part_path)
+            if ent is None:
+                print(
+                    f"serve: snapshot {path!r} manifest no longer "
+                    "parses; leaving it for triage",
+                    file=sys.stderr, flush=True)
+                return []
+            staged.append((ent, leaves, manifest, part_path))
+        out = []
+        for ent, leaves, manifest, part_path in staged:
+            save_checkpoint_raw(part_path, leaves,
+                                meta={"plane": "serve"},
+                                serve_manifest=manifest)
+            out.append(ent)
+        os.remove(path)
+        self.metrics.inc("serve_migrations")
+        print(
+            f"serve: migrated snapshot {path!r}: {old_L} -> {new_L} "
+            f"lane(s), {len(out)} batch(es), resuming at beat "
+            f"{serve.get('beats_done', '?')}",
+            file=sys.stderr, flush=True,
+        )
+        return out
+
+    def _migrate_inflight(self, key: ClassKey, reqs: list,
+                          new_lanes: int, snap_path: str | None) -> None:
+        """An in-flight launch hit a resize request at a beat boundary
+        (the boundary snapshot was just written): apply the new lane
+        count, reshard the snapshot, and queue the migrated sub-batches
+        ahead of packer traffic. Requests keep their rids and their
+        submit clocks — a migration is invisible in the result records
+        except for `resumed_from_beat`. Without a usable snapshot the
+        requests requeue from beat 0 in chunks of the new width
+        (deterministic replay keeps the results exact, it just repays
+        the completed beats)."""
+        with self._cond:
+            self._apply_resize_locked(new_lanes)
+        entries: list[tuple] = []
+        if snap_path and os.path.exists(snap_path):
+            entries = self._migrate_snapshot(snap_path)
+        if not entries:
+            L = self.max_lanes
+            base = snap_path or self.snapshot_path
+            entries = [
+                (key, reqs[i:i + L],
+                 f"{base}.part{i // L}" if base else None)
+                for i in range(0, len(reqs), L)
+            ]
+            print(
+                "serve: no usable snapshot for the in-flight resize; "
+                f"requeuing {len(reqs)} request(s) from beat 0 in "
+                f"{len(entries)} batch(es)",
+                file=sys.stderr, flush=True)
+        with self._cond:
+            self._resume.extend(entries)
+            self._cond.notify()
 
     # -- launch worker ---------------------------------------------------
 
@@ -566,11 +911,16 @@ class SimService:
             with self._cond:
                 key = None
                 reqs = None
+                snap = self.snapshot_path
                 while not self._stopping:
-                    if self._resume is not None:
-                        key, reqs = self._resume
-                        self._resume = None
+                    if self._resume:
+                        key, reqs, snap = self._resume.pop(0)
                         break
+                    if self._resize_to is not None:
+                        # idle resize: no batch in flight, nothing to
+                        # migrate — just change width
+                        self._apply_resize_locked(self._resize_to)
+                        continue
                     key = self.packer.ready()
                     if key is not None:
                         break
@@ -593,23 +943,35 @@ class SimService:
             if not reqs:
                 continue
             try:
-                self._run_batch(key, reqs)
+                self._run_batch(key, reqs, snap_path=snap)
             except Exception as e:  # noqa: BLE001 - one bad batch must not kill the worker
                 self._fail_requests(key, reqs, e)
             finally:
                 self.metrics.set("serve_inflight", 0)
 
     def _run_batch(self, key: ClassKey, reqs: list,
-                   depth: int = 0) -> None:
+                   depth: int = 0, snap_path: str | None = None) -> None:
         """One supervised batch: retry `_launch` with exponential
         backoff (each retry resumes from the newest snapshot when
         enabled), then bisect to isolate poison. Terminal failures land
-        on `_fail_requests`; the worker thread always survives."""
+        on `_fail_requests`; the worker thread always survives — except
+        for device loss, which exits EXIT_PEER_LOST so the outer retry
+        loop relaunches the process at a smaller mesh (the snapshot
+        stays on disk for `resume_pending_batch`). A resize request is
+        not a failure at all: the batch migrates in process."""
+        if snap_path is None:
+            snap_path = self.snapshot_path
         attempt = 0
         while True:
             try:
-                self._launch(key, reqs)
+                self._launch(key, reqs, snap_path=snap_path)
+            except ResizeRequested as e:
+                self._migrate_inflight(key, reqs, e.lanes, snap_path)
+                return
             except Exception as e:  # noqa: BLE001 - classified below, never propagated
+                if self._is_device_loss(e):
+                    self._on_device_loss(key, reqs, e, snap_path)
+                    return  # reached only with an injectable exit hook
                 if attempt < self.launch_retries:
                     attempt += 1
                     self.metrics.inc("serve_launch_retries")
@@ -640,7 +1002,7 @@ class SimService:
                     # their halves. The halves are fresh batches — the
                     # dead attempt's snapshot no longer matches them.
                     self.metrics.inc("serve_bisections")
-                    self._clear_snapshot()
+                    self._clear_snapshot(snap_path)
                     if self._tracer is not None:
                         self._tracer.event(
                             "bisect", rids=[r.rid for r in reqs],
@@ -652,10 +1014,12 @@ class SimService:
                         f"class {key} ({type(e).__name__}: {e})",
                         file=sys.stderr, flush=True,
                     )
-                    self._run_batch(key, reqs[:mid], depth + 1)
-                    self._run_batch(key, reqs[mid:], depth + 1)
+                    self._run_batch(key, reqs[:mid], depth + 1,
+                                    snap_path)
+                    self._run_batch(key, reqs[mid:], depth + 1,
+                                    snap_path)
                 else:
-                    self._clear_snapshot()
+                    self._clear_snapshot(snap_path)
                     self._fail_requests(key, reqs, e)
                 return
             else:
@@ -700,13 +1064,40 @@ class SimService:
                     file=sys.stderr, flush=True,
                 )
 
+    # -- device loss -----------------------------------------------------
+
+    _DEVLOSS_MARKERS = ("device lost", "peer lost", "data loss")
+
+    def _is_device_loss(self, e: Exception) -> bool:
+        """The chaos injector's DeviceLost, or a backend failure whose
+        message reads like a vanished device — either way the compiled
+        shape is gone and an in-process retry would just re-trip it."""
+        if isinstance(e, DeviceLost):
+            return True
+        msg = str(e).lower()
+        return any(m in msg for m in self._DEVLOSS_MARKERS)
+
+    def _on_device_loss(self, key: ClassKey, reqs: list, e: Exception,
+                        snap_path: str | None) -> None:
+        from shadow_tpu.runtime.supervisor import EXIT_PEER_LOST
+
+        print(
+            f"serve: DEVICE LOST mid-batch (class {key}, {len(reqs)} "
+            f"request(s)): {type(e).__name__}: {e}; snapshot "
+            f"{snap_path!r} kept for the relaunch — exiting "
+            f"{EXIT_PEER_LOST} so an outer --retry loop relaunches at "
+            "a smaller mesh and resume_pending_batch migrates the "
+            "batch", file=sys.stderr, flush=True)
+        self._peer_lost_exit(EXIT_PEER_LOST)
+
     # -- snapshots -------------------------------------------------------
 
     def _snapshot_enabled(self) -> bool:
         return self.snapshot_beats > 0 and bool(self.snapshot_path)
 
     def _write_snapshot(self, key: ClassKey, reqs: list, st,
-                        beats_done: int, stops) -> None:
+                        beats_done: int, stops,
+                        path: str | None = None) -> None:
         from shadow_tpu.utils.checkpoint import save_checkpoint
 
         manifest = {
@@ -720,16 +1111,21 @@ class SimService:
             "max_lanes": self.max_lanes,
             "stops": [int(s) for s in stops.tolist()],
         }
-        save_checkpoint(self.snapshot_path, st,
+        save_checkpoint(path or self.snapshot_path, st,
                         meta={"plane": "serve"},
                         serve_manifest=manifest)
         self.metrics.inc("serve_snapshots")
 
-    def _load_snapshot(self, key: ClassKey, reqs: list, template):
+    def _load_snapshot(self, key: ClassKey, reqs: list, template,
+                       path: str | None = None):
         """(state, beats_done) from a verified snapshot matching this
         exact batch, or None. A mismatched or damaged snapshot is
-        ignored (and removed — it can never be resumed by anyone)."""
-        path = self.snapshot_path
+        ignored (and removed — it can never be resumed by anyone). A
+        migrated part whose state has fewer lanes than the compiled
+        width (`state_lanes`, the grow path) loads against a lane-slice
+        of the template and pads back up with the template's own inert
+        lanes — those lanes carry no requests and never step."""
+        path = path or self.snapshot_path
         if not path or not os.path.exists(path):
             return None
         from shadow_tpu.utils.checkpoint import (
@@ -746,21 +1142,37 @@ class SimService:
                     or serve.get("beat_windows") != self.beat_windows
                     or serve.get("max_lanes") != self.max_lanes):
                 return None
+            P = int(serve.get("state_lanes") or serve.get("max_lanes"))
+            if not (0 < P <= self.max_lanes) or P < len(reqs):
+                return None
             verify_checkpoint(path)
-            state, _ = load_checkpoint(path, template)
+            if P != self.max_lanes:
+                import jax
+                import numpy as np
+
+                from shadow_tpu.runtime.fleet import lane_merge
+
+                sub = jax.tree.map(lambda x: x[:P], template)
+                part, _ = load_checkpoint(path, sub)
+                pads = jax.tree.map(lambda x: np.asarray(x)[P:],
+                                    template)
+                state = lane_merge([jax.device_get(part), pads])  # shadowlint: no-deadline=startup resume path, before the serving loop; the part was CRC-verified host bytes a moment ago
+            else:
+                state, _ = load_checkpoint(path, template)
         except ValueError as e:
             print(
                 f"serve: discarding unusable snapshot {path!r}: {e}",
                 file=sys.stderr, flush=True,
             )
-            self._clear_snapshot()
+            self._clear_snapshot(path)
             return None
         return state, int(serve["beats_done"])
 
-    def _clear_snapshot(self) -> None:
-        if self.snapshot_path:
+    def _clear_snapshot(self, path: str | None = None) -> None:
+        path = path or self.snapshot_path
+        if path:
             try:
-                os.remove(self.snapshot_path)
+                os.remove(path)
             except FileNotFoundError:
                 pass
 
@@ -818,14 +1230,24 @@ class SimService:
             state_override=override,
         )
 
-    def _launch(self, key: ClassKey, reqs: list) -> None:
+    def _launch(self, key: ClassKey, reqs: list,
+                snap_path: str | None = None) -> None:
         import numpy as np
 
+        if snap_path is None:
+            snap_path = self.snapshot_path
+        snap_on = self.snapshot_beats > 0 and bool(snap_path)
         tr = self._tracer
         t_entry = tr.now() if tr is not None else 0.0
         hits_before = self.cache.hits
         factory = (self._fleet_factory or self._build_entry)
-        entry = self.cache.get(key, lambda: factory(key, reqs[0]))
+        # the device-generation key: generation 0 (no elastic event
+        # ever) keys by ClassKey alone — byte-identical cache behavior
+        # to the pre-elastic plane; after a migration/resize the bumped
+        # generation invalidates every old-shape program (stale entries
+        # age out through the LRU)
+        ck = key if self._generation == 0 else (key, self._generation)
+        entry = self.cache.get(ck, lambda: factory(key, reqs[0]))
         cache_hit = self.cache.hits > hits_before
         t_cache = tr.now() if tr is not None else 0.0
         fleet = entry.fleet
@@ -857,8 +1279,8 @@ class SimService:
                            np.int64)
         beats_done = 0
         resumed_from = None
-        if self._snapshot_enabled():
-            loaded = self._load_snapshot(key, reqs, st)
+        if snap_on:
+            loaded = self._load_snapshot(key, reqs, st, snap_path)
             if loaded is not None:
                 st = fleet.adopt_state(loaded[0])
                 beats_done = resumed_from = loaded[1]
@@ -896,10 +1318,28 @@ class SimService:
             while True:
                 beat = beats_done + 1
                 t_b0 = tr.now() if tr is not None else 0.0
+                # operator resize (SIGHUP) lands here, at the beat
+                # boundary `st` already sits on: persist the boundary
+                # and let _run_batch migrate. The chaos `resize`
+                # injector raises the same exception from fire() — give
+                # it the same boundary snapshot on the way out.
+                rz = self._resize_to
+                if rz is not None and rz != self.max_lanes:
+                    if snap_on:
+                        self._write_snapshot(key, reqs, st, beats_done,
+                                             stops, path=snap_path)
+                    raise ResizeRequested(rz)
                 if self._chaos:
-                    self._chaos.fire(
-                        "beat", beat=beat,
-                        seeds=tuple(r.seed for r in reqs))
+                    try:
+                        self._chaos.fire(
+                            "beat", beat=beat,
+                            seeds=tuple(r.seed for r in reqs))
+                    except ResizeRequested:
+                        if snap_on:
+                            self._write_snapshot(key, reqs, st,
+                                                 beats_done, stops,
+                                                 path=snap_path)
+                        raise
                 for _ in range(self.beat_windows):
                     st = fleet.step_window(st, stops, binds=binds)
                 st, bundle = entry.harvest.extract(st, full=False)
@@ -943,11 +1383,10 @@ class SimService:
                 if all(i in timed_out or sums[i]["now_ns"] >= r.stop_ns
                        for i, r in enumerate(reqs)):
                     break
-                if (self._snapshot_enabled()
-                        and beats_done % self.snapshot_beats == 0):
+                if snap_on and beats_done % self.snapshot_beats == 0:
                     t_s0 = tr.now() if tr is not None else 0.0
                     self._write_snapshot(key, reqs, st, beats_done,
-                                         stops)
+                                         stops, path=snap_path)
                     if tr is not None:
                         tr.span("snapshot", t0=t_s0, t1=tr.now(),
                                 launch=launch_no, cls=str(key),
@@ -1020,5 +1459,5 @@ class SimService:
         if timed_out:
             self.metrics.inc("serve_timeouts", len(timed_out))
         self.metrics.inc("serve_results", n_done)
-        if self._snapshot_enabled():
-            self._clear_snapshot()
+        if snap_on:
+            self._clear_snapshot(snap_path)

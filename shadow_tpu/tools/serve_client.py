@@ -57,12 +57,37 @@ def request_docs(n: int, *, mix: str = "mixed", hosts: int = 8,
     return docs
 
 
+# Bounded connection retry across a server restart window: an elastic
+# server that hits device loss exits and is relaunched by its --retry
+# wrapper, so every request in flight from the CLIENT side sees
+# connection-refused/reset for a second or two. `_RETRY` is module
+# state so the report can surface how often the window was crossed;
+# retries=0 (the default) keeps the old fail-fast behavior.
+_RETRY = {"retries": 0, "backoff_s": 0.25, "count": 0}
+
+
 def _http(url: str, data: bytes | None = None, timeout: float = 10.0):
     req = urllib.request.Request(
         url, data=data,
         headers={"Content-Type": "application/json"} if data else {})
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.status, json.loads(resp.read().decode("utf-8"))
+    attempt = 0
+    while True:
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(
+                    resp.read().decode("utf-8"))
+        except (urllib.error.URLError, ConnectionResetError) as e:
+            # RemoteDisconnected subclasses ConnectionResetError and
+            # sometimes escapes urllib unwrapped mid-restart
+            reason = getattr(e, "reason", e)
+            refused = isinstance(
+                reason, (ConnectionRefusedError, ConnectionResetError,
+                         ConnectionAbortedError))
+            if not refused or attempt >= _RETRY["retries"]:
+                raise
+            attempt += 1
+            _RETRY["count"] += 1
+            time.sleep(_RETRY["backoff_s"] * (2 ** (attempt - 1)))
 
 
 def fetch_traces(url: str, rids: list[str]) -> dict[str, dict]:
@@ -176,6 +201,7 @@ def run_load(url: str, docs: list[dict], *, out_dir: str | None = None,
                                 default=0),
         "launches": len({r["launch"] for r in done}),
         "cache_hits_seen": sum(1 for r in done if r.get("cache_hit")),
+        "conn_retries": _RETRY["count"],
     }
     if trees:
         report["traced"] = len(trees)
@@ -202,12 +228,21 @@ def main(argv=None) -> int:
                    help="write each result record to DIR/<rid>.json "
                         "(diff_runs-able against solo summaries)")
     p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--connect-retries", type=int, default=0,
+                   help="bounded connection-refused retries per HTTP "
+                        "call — rides out an elastic server's restart "
+                        "window (0 = fail fast)")
+    p.add_argument("--connect-backoff", type=float, default=0.25,
+                   help="base backoff seconds between connection "
+                        "retries (doubles per attempt)")
     p.add_argument("--print-docs", action="store_true",
                    help="print the request docs (one JSON per line) "
                         "and exit without contacting the server — for "
                         "generating matching solo references")
     args = p.parse_args(argv)
 
+    _RETRY["retries"] = max(int(args.connect_retries), 0)
+    _RETRY["backoff_s"] = max(float(args.connect_backoff), 0.0)
     docs = request_docs(args.requests, mix=args.mix, hosts=args.hosts,
                         stop_s=args.stop_s, seed0=args.seed0)
     if args.print_docs:

@@ -361,6 +361,67 @@ def read_header_info(path: str) -> dict:
     }
 
 
+def load_checkpoint_raw(path: str) -> tuple[dict, dict[str, np.ndarray]]:
+    """Read a checkpoint WITHOUT a template: (header, {leaf_path:
+    array}) with every leaf verified against its stored CRC32.
+
+    This is the file-level half of serving-plane lane migration
+    (docs/17-Serving.md "Elasticity"): the migrator slices the raw
+    `[L, ...]` leaves along the lane axis and writes the parts back
+    through `save_checkpoint_raw` under the SAME leaf-path keys, so a
+    part file loads against a smaller-shape template via the ordinary
+    tree-path matching of `load_checkpoint` — no template needed at
+    migration time, when the old shape's fleet no longer exists.
+    """
+    header, leaves = _read_raw(path)
+    crcs = header.get("crc32")
+    if crcs is not None:
+        for i, (arr, want) in enumerate(zip(leaves, crcs)):
+            got = _crc(arr)
+            if got != want:
+                pth = header["paths"][i] if i < len(header["paths"]) else "?"
+                raise ValueError(
+                    f"checkpoint {path!r}: CRC mismatch on leaf {i} "
+                    f"({pth}): stored {want:#010x}, computed {got:#010x} "
+                    "— the file was damaged after it was written"
+                )
+    return header, dict(zip(header["paths"], leaves))
+
+
+def save_checkpoint_raw(path: str, leaves_by_path: dict[str, np.ndarray],
+                        *, meta: dict | None = None,
+                        mesh_info: dict | None = None,
+                        serve_manifest: dict | None = None) -> None:
+    """Write pre-flattened `{leaf_path: array}` leaves as a checkpoint,
+    preserving the given path strings verbatim (insertion order is the
+    leaf order). Shapes, dtypes, and per-leaf CRCs are recomputed from
+    the arrays, so a lane-sliced copy of a loaded file carries honest
+    integrity data of its own. Same atomic tmp+fsync+rename write as
+    `save_checkpoint`."""
+    paths = list(leaves_by_path)
+    leaves = [np.asarray(leaves_by_path[p]) for p in paths]
+    header = {
+        "format_version": FORMAT_VERSION,
+        "n_leaves": len(leaves),
+        "paths": paths,
+        "shapes": [list(np.shape(x)) for x in leaves],
+        "dtypes": [str(x.dtype) for x in leaves],
+        "crc32": [_crc(x) for x in leaves],
+        "extra": {},
+        "meta": meta or {},
+        "xchg_empty": _xchg_empty(paths, leaves),
+    }
+    if mesh_info is not None:
+        header["mesh"] = dict(mesh_info)
+    if serve_manifest is not None:
+        header["serve"] = dict(serve_manifest)
+    arrs = {f"leaf_{i}": x for i, x in enumerate(leaves)}
+    arrs["__header__"] = np.frombuffer(
+        json.dumps(header).encode("utf-8"), dtype=np.uint8
+    )
+    _write_atomic(path, arrs, keep=1)
+
+
 def _shard_sets(path: str) -> dict[int, dict[int, str]]:
     """{set_size: {member_index: member_path}} for files named
     `<path>.shard<i>of<n>` next to `path`."""
@@ -436,6 +497,23 @@ def find_resume_checkpoint(path: str):
             skipped.append((
                 member_paths[0] if len(member_paths) == 1
                 else str(member_paths), str(e)))
+            continue
+        # a serving-plane lane snapshot (v7 "serve" manifest) is a
+        # lane-STACKED batch state, not a batch-run state — loading it
+        # into a solo template would fail with a baffling shape
+        # mismatch, so refuse it by name and point at the right door
+        serve_member = next(
+            (p for p in member_paths
+             if read_header_info(p).get("serve") is not None), None)
+        if serve_member is not None:
+            skipped.append((
+                serve_member,
+                "serving-plane lane snapshot (v7 'serve' manifest) — "
+                "batch-run --resume auto cannot load a lane-stacked "
+                "batch state; restart `shadow_tpu serve` with the same "
+                "--snapshot-path and let resume_pending_batch pick up "
+                "the in-flight batch instead",
+            ))
             continue
         return chosen, meta, skipped
     raise ValueError(

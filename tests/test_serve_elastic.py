@@ -1,0 +1,692 @@
+"""Elastic serving pins (ISSUE 19, docs/17-Serving.md "Elasticity").
+
+The contract, layer by layer:
+
+- lane-axis reshard (`runtime.fleet.lane_reshard`/`lane_merge`): an
+  `[L, ...]` state tree splits into even sub-trees and merges back
+  losslessly; odd splits, scalar leaves and disagreeing leading dims
+  are refused loudly;
+- snapshot migration: a beat-boundary snapshot written at one lane
+  count resumes at another — shrink reshards into `.part*` files whose
+  manifests carry the ORIGINAL rids/seqs/docs in chunk order, grow
+  pads back up with inert template lanes — and every migrated request
+  completes bit-identical to the unmolested run;
+- device loss: the `devloss` chaos injector exits EXIT_PEER_LOST=77
+  with the snapshot kept on disk; a half-width relaunch migrates and
+  finishes the batch under the same rids;
+- resize: the `resize` injector (and `SimService.resize`, the SIGHUP
+  path) migrates in process — idle resizes just change width;
+- generation: every elastic event bumps the mesh generation, which
+  keys the program cache (stale shapes age out) and rides /healthz
+  with `degraded_capacity` while below the peak; generation 0 keeps
+  the health body and cache keys byte-identical to the pre-elastic
+  plane (zero-cost discipline);
+- cross-process: `next_retry_argv` halves --max-lanes for a serve argv
+  on peer-lost and never appends --resume; `find_resume_checkpoint`
+  refuses a serve lane snapshot by name; serve_client rides out the
+  restart window with bounded connection retries;
+- registry: tgen / tor / bitcoin classify and validate without
+  building, and (slow) serve bit-identical to their solo references.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from shadow_tpu.runtime.fleet import lane_merge, lane_reshard
+from shadow_tpu.runtime.supervisor import EXIT_PEER_LOST, next_retry_argv
+from shadow_tpu.serve.chaos import (
+    DeviceLost,
+    ResizeRequested,
+    ServeChaos,
+)
+from shadow_tpu.serve.service import (
+    SCENARIOS,
+    CacheEntry,
+    SimService,
+    request_class,
+    solo_reference,
+    validate_request,
+)
+from test_serve import (
+    NAMES,
+    _doc,
+    _fake_entry_factory,
+    _FakeFleet,
+    _FakeHarvest,
+    _req,
+    _tot,
+    _wait_done,
+)
+
+# ------------------------------------------------------ lane-axis reshard
+
+
+def _state(lanes, seeds=None):
+    L = int(lanes)
+    return {
+        "now_ns": np.arange(L, dtype=np.int64) * 10,
+        "windows": np.arange(L, dtype=np.int64),
+        "seeds": np.asarray(seeds if seeds is not None else range(L),
+                            np.int64),
+    }
+
+
+def test_lane_reshard_split_values_and_recurse():
+    st = _state(8)
+    parts = lane_reshard(st, 4)
+    assert isinstance(parts, list) and len(parts) == 2
+    for j, part in enumerate(parts):
+        for k in st:
+            assert part[k].shape[0] == 4
+            assert list(part[k]) == list(st[k][4 * j:4 * (j + 1)])
+    # a part reshards again: 8 -> 4 -> 2
+    sub = lane_reshard(parts[1], 2)
+    assert len(sub) == 2
+    assert list(sub[1]["seeds"]) == [6, 7]
+
+
+def test_lane_merge_roundtrips_and_edge_cases():
+    st = _state(8)
+    merged = lane_merge(lane_reshard(st, 2))
+    for k in st:
+        assert list(merged[k]) == list(st[k])
+    # single part: identity
+    same = lane_merge([st])
+    assert same is st
+    with pytest.raises(ValueError, match="no states"):
+        lane_merge([])
+
+
+def test_lane_reshard_refusals_are_loud():
+    st = _state(8)
+    # odd split: the error names the counts and the stranding hazard
+    with pytest.raises(ValueError, match="divide"):
+        lane_reshard(st, 3)
+    with pytest.raises(ValueError, match="lanes"):
+        lane_reshard(st, 0)
+    # a scalar leaf has no lane axis — named by tree path
+    with pytest.raises(ValueError, match="now_ns"):
+        lane_reshard({"now_ns": np.int64(7)}, 1)
+    # leaves disagreeing on the leading dim
+    with pytest.raises(ValueError):
+        lane_reshard({"a": np.zeros(8), "b": np.zeros(4)}, 2)
+
+
+# ----------------------------------------------------- chaos injectors
+
+
+def test_chaos_devloss_and_resize_parse_and_fire(tmp_path):
+    with pytest.raises(ValueError, match="needs beat="):
+        ServeChaos("devloss:lanes=2")
+    with pytest.raises(ValueError, match="needs lanes="):
+        ServeChaos("resize:beat=1")
+
+    c = ServeChaos("devloss:beat=2")
+    c.fire("beat", beat=1)  # wrong beat: silent
+    with pytest.raises(DeviceLost, match="beat 2"):
+        c.fire("beat", beat=2)
+    c.fire("beat", beat=2)  # one-shot
+
+    r = ServeChaos("resize:beat=3,lanes=8")
+    with pytest.raises(ResizeRequested) as e:
+        r.fire("beat", beat=3)
+    assert e.value.lanes == 8
+    r.fire("beat", beat=3)  # one-shot
+
+    # marker-dir one-shots survive a relaunch (fresh instance)
+    d = str(tmp_path)
+    c1 = ServeChaos("devloss:beat=1", marker_dir=d)
+    with pytest.raises(DeviceLost):
+        c1.fire("beat", beat=1)
+    assert list(tmp_path.glob("serve_chaos.devloss.*.fired"))
+    ServeChaos("devloss:beat=1", marker_dir=d).fire("beat", beat=1)
+
+
+# --------------------------------------------- snapshot migration (fake)
+
+_KW = dict(max_lanes=4, pack_deadline_ms=30.0, beat_windows=2,
+           snapshot_beats=1)
+
+
+def _reference(docs, lanes=4):
+    ref = SimService(fleet_factory=_fake_entry_factory(lanes),
+                     max_lanes=lanes, pack_deadline_ms=30.0,
+                     beat_windows=2).start()
+    try:
+        rids = [ref.submit(d)["request_id"] for d in docs]
+        recs = _wait_done(ref, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        ref.drain()
+    return [recs[r]["summary"] for r in rids]
+
+
+def _dead_writer_snapshot(tmp_path, docs, beats=3, lanes=4):
+    """A snapshot exactly as a `lanes`-wide writer's beat loop would
+    have left it at beat `beats` before dying (the test_serve restart
+    pin's recipe, at width 4)."""
+    snap = str(tmp_path / "snap.npz")
+    svc = SimService(fleet_factory=_fake_entry_factory(lanes),
+                     snapshot_path=snap, max_lanes=lanes,
+                     pack_deadline_ms=30.0, beat_windows=2,
+                     snapshot_beats=1)
+    reqs = [_req(d, seq=i) for i, d in enumerate(docs)]
+    key = request_class(reqs[0])
+    entry = _fake_entry_factory(lanes)(key, reqs[0])
+    st, binds = entry.fleet.make_inputs(svc._batch_plan(key, reqs, lanes))
+    stops = np.asarray([r.stop_ns for r in reqs]
+                       + [0] * (lanes - len(reqs)), np.int64)
+    for _ in range(beats * 2):  # beat_windows=2
+        st = entry.fleet.step_window(st, stops, binds=binds)
+    svc._write_snapshot(key, reqs, st, beats, stops)
+    return snap, key, reqs
+
+
+def test_migrate_snapshot_shrink_part_manifests_preserve_rids(tmp_path):
+    """The file-level half: an 8-rid... here 4-rid snapshot at width 4
+    splits into two width-2 parts whose manifests carry the rid/seq/doc
+    chunks in order, under the same leaf paths."""
+    from shadow_tpu.utils.checkpoint import read_header_info
+
+    docs = [_doc(s) for s in (41, 42, 43, 44)]
+    snap, key, reqs = _dead_writer_snapshot(tmp_path, docs)
+    svc2 = SimService(fleet_factory=_fake_entry_factory(2),
+                      snapshot_path=snap, max_lanes=2,
+                      pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1)
+    entries = svc2._migrate_snapshot(snap)
+    assert [p for _k, _r, p in entries] == [snap + ".part0",
+                                            snap + ".part1"]
+    assert not os.path.exists(snap)  # source consumed
+    for j, (_key, part_reqs, part_path) in enumerate(entries):
+        serve = read_header_info(part_path)["serve"]
+        lo = 2 * j
+        assert serve["rids"] == [r.rid for r in reqs[lo:lo + 2]]
+        assert serve["seqs"] == [r.seq for r in reqs[lo:lo + 2]]
+        assert serve["docs"] == [r.doc() for r in reqs[lo:lo + 2]]
+        assert serve["max_lanes"] == 2 and "state_lanes" not in serve
+        assert serve["beats_done"] == 3
+        assert [r.rid for r in part_reqs] == serve["rids"]
+    assert _tot(svc2, "serve_migrations") == 1
+
+
+def test_shrink_migration_resumes_bit_identical(tmp_path):
+    """The whole shrink story in process: a width-4 writer dies at beat
+    3; a width-2 relaunch migrates, resumes both sub-batches under the
+    ORIGINAL rids, and every summary matches the unmolested run."""
+    docs = [_doc(s) for s in (51, 52, 53, 54)]
+    want = _reference(docs)
+    snap, _key, reqs = _dead_writer_snapshot(tmp_path, docs)
+
+    svc2 = SimService(fleet_factory=_fake_entry_factory(2),
+                      snapshot_path=snap, max_lanes=2,
+                      pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1)
+    assert svc2.resume_pending_batch() == 4
+    assert svc2.result("r000000")["status"] == "queued"
+    # the migration bumped the generation and the peak watermark says
+    # the mesh is running below the capacity it served at
+    h = svc2.health()
+    assert h["mesh_generation"] == 1 and h["max_lanes"] == 2
+    assert h["degraded_capacity"] is True and h["peak_lanes"] == 4
+
+    svc2.start()
+    rids = [r.rid for r in reqs]
+    recs = _wait_done(svc2, rids, timeout_s=60, poll_s=0.05)
+    assert _tot(svc2, "serve_migrations") == 1
+    assert _tot(svc2, "serve_resumes") == 2  # one per sub-batch
+    for rid, summary in zip(rids, want):
+        assert recs[rid]["status"] == "done", recs[rid]
+        assert recs[rid]["summary"] == summary
+        assert recs[rid]["resumed_from_beat"] == 3
+    # every part consumed on completion; new submissions sequence past
+    # the resumed ids
+    assert not list(tmp_path.glob("snap.npz.part*"))
+    assert svc2.submit(_doc(9))["request_id"] == "r000004"
+    svc2.drain()
+
+
+def test_grow_migration_pads_with_inert_lanes(tmp_path):
+    """Grow: a width-2 snapshot resumes on a width-4 mesh via the
+    `state_lanes` manifest key — the loader pads with template lanes
+    that carry no requests and never step."""
+    from shadow_tpu.utils.checkpoint import read_header_info
+
+    docs = [_doc(s) for s in (61, 62)]
+    want = _reference(docs, lanes=2)
+    snap, _key, reqs = _dead_writer_snapshot(tmp_path, docs, lanes=2)
+
+    svc2 = SimService(fleet_factory=_fake_entry_factory(4),
+                      snapshot_path=snap, **_KW)
+    assert svc2.resume_pending_batch() == 2
+    part = snap + ".part0"
+    serve = read_header_info(part)["serve"]
+    assert serve["max_lanes"] == 4 and serve["state_lanes"] == 2
+    svc2.start()
+    recs = _wait_done(svc2, [r.rid for r in reqs], timeout_s=60,
+                      poll_s=0.05)
+    for rid, summary in zip([r.rid for r in reqs], want):
+        assert recs[rid]["status"] == "done", recs[rid]
+        assert recs[rid]["summary"] == summary
+        assert recs[rid]["resumed_from_beat"] == 3
+    # grown back to (or past) the peak: capacity no longer degraded
+    h = svc2.health()
+    assert h["mesh_generation"] == 1
+    assert "degraded_capacity" not in h
+    svc2.drain()
+
+
+def test_migrate_refuses_nondividing_lane_count(tmp_path, capsys):
+    """4 lanes into width 3 does not divide: the migration refuses
+    loudly and leaves the file for triage instead of stranding lanes."""
+    docs = [_doc(s) for s in (71, 72, 73, 74)]
+    snap, _key, _reqs = _dead_writer_snapshot(tmp_path, docs)
+    svc2 = SimService(fleet_factory=_fake_entry_factory(3),
+                      snapshot_path=snap, max_lanes=3,
+                      pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1)
+    assert svc2.resume_pending_batch() == 0
+    assert os.path.exists(snap)  # left for triage, never deleted
+    assert "cannot migrate snapshot" in capsys.readouterr().err
+    assert _tot(svc2, "serve_migrations") == 0
+
+
+# ------------------------------------------------ device loss (fake)
+
+
+def test_devloss_exits_77_and_half_width_relaunch_finishes(tmp_path):
+    docs = [_doc(s) for s in (81, 82)]
+    want = _reference(docs, lanes=2)
+    snap = str(tmp_path / "snap.npz")
+    exits = []
+    svc1 = SimService(fleet_factory=_fake_entry_factory(2),
+                      snapshot_path=snap, max_lanes=2,
+                      pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1, launch_retries=1,
+                      chaos=ServeChaos("devloss:beat=2"),
+                      peer_lost_exit=exits.append).start()
+    try:
+        rids = [svc1.submit(d)["request_id"] for d in docs]
+        deadline = time.monotonic() + 30
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        svc1.drain()
+    # device loss is NOT retried in place: straight to the exit hook,
+    # with the beat-1 snapshot kept on disk for the relaunch
+    assert exits == [EXIT_PEER_LOST]
+    assert _tot(svc1, "serve_launch_retries") == 0
+    assert os.path.exists(snap)
+
+    # "the relaunch": --retry halved --max-lanes 2 -> 1
+    svc2 = SimService(fleet_factory=_fake_entry_factory(1),
+                      snapshot_path=snap, max_lanes=1,
+                      pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1, generation=1)
+    assert svc2.resume_pending_batch() == 2
+    svc2.start()
+    recs = _wait_done(svc2, rids, timeout_s=60, poll_s=0.05)
+    svc2.drain()
+    for rid, summary in zip(rids, want):
+        assert recs[rid]["status"] == "done", recs[rid]
+        assert recs[rid]["summary"] == summary
+        assert recs[rid]["resumed_from_beat"] == 1
+    # seeded generation 1 + the migration bump
+    assert svc2._generation == 2
+
+
+def test_is_device_loss_classifies_backend_messages():
+    svc = SimService(fleet_factory=_fake_entry_factory(1), max_lanes=1)
+    assert svc._is_device_loss(DeviceLost("gone"))
+    assert svc._is_device_loss(RuntimeError("DATA LOSS: tpu burned"))
+    assert svc._is_device_loss(RuntimeError("peer lost: worker 3"))
+    assert not svc._is_device_loss(RuntimeError("shape mismatch"))
+
+
+# ------------------------------------------------------- resize (fake)
+
+
+def _elastic_factory(box):
+    """A fake entry factory whose fleet width tracks the service's
+    CURRENT max_lanes — what a real recompile at the new shape does."""
+    def factory(key, probe):
+        return CacheEntry(key=key, fleet=_FakeFleet(box["svc"].max_lanes),
+                          harvest=_FakeHarvest(), names=NAMES)
+    return factory
+
+
+def test_inflight_resize_migrates_in_process(tmp_path):
+    docs = [_doc(s) for s in (91, 92)]
+    want = _reference(docs, lanes=2)
+    snap = str(tmp_path / "snap.npz")
+    box = {}
+    svc = SimService(fleet_factory=_elastic_factory(box),
+                     snapshot_path=snap, max_lanes=2,
+                     pack_deadline_ms=30.0, beat_windows=2,
+                     snapshot_beats=1,
+                     chaos=ServeChaos("resize:beat=2,lanes=4"))
+    box["svc"] = svc
+    svc.start()
+    try:
+        rids = [svc.submit(d)["request_id"] for d in docs]
+        recs = _wait_done(svc, rids, timeout_s=60, poll_s=0.05)
+    finally:
+        svc.drain()
+    for rid, summary in zip(rids, want):
+        assert recs[rid]["status"] == "done", recs[rid]
+        assert recs[rid]["summary"] == summary
+        # migrated off the boundary snapshot, not replayed from zero
+        assert recs[rid]["resumed_from_beat"] == 1
+    assert svc.max_lanes == 4 and svc.packer.max_lanes == 4
+    assert _tot(svc, "serve_migrations") == 1
+    assert svc._generation == 1
+    assert not list(tmp_path.glob("snap.npz*"))
+
+
+def test_idle_resize_applies_without_migration():
+    box = {}
+    svc = SimService(fleet_factory=_elastic_factory(box), max_lanes=2,
+                     pack_deadline_ms=30.0, beat_windows=2)
+    box["svc"] = svc
+    svc.start()
+    try:
+        with pytest.raises(ValueError, match="lanes"):
+            svc.resize(0)
+        svc.resize(8)
+        deadline = time.monotonic() + 10
+        while svc.max_lanes != 8 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.max_lanes == 8 and svc.packer.max_lanes == 8
+        assert svc._generation == 1
+        assert _tot(svc, "serve_migrations") == 0
+        h = svc.health()
+        assert h == {"status": "ok", "mesh_generation": 1,
+                     "max_lanes": 8}
+    finally:
+        svc.drain()
+
+
+# ------------------------------------------- generation-keyed cache
+
+
+def test_generation_keys_cache_and_health_zero_cost():
+    reqs = [_req(_doc(1))]
+    key = request_class(reqs[0])
+
+    # generation 0: bare ClassKey, health body byte-identical
+    svc0 = SimService(fleet_factory=_fake_entry_factory(2), max_lanes=2,
+                      pack_deadline_ms=30.0, beat_windows=2)
+    svc0._run_batch(key, reqs)
+    assert svc0.cache.keys() == [key]
+    assert svc0.health() == {"status": "ok"}
+
+    # a relaunched process seeds its generation from the retry attempt
+    svc1 = SimService(fleet_factory=_fake_entry_factory(2), max_lanes=2,
+                      pack_deadline_ms=30.0, beat_windows=2,
+                      generation=2)
+    svc1._run_batch(key, reqs)
+    assert svc1.cache.keys() == [(key, 2)]
+    assert svc1.health() == {"status": "ok", "mesh_generation": 2,
+                             "max_lanes": 2}
+    assert (svc1.metrics.totals()
+            ["shadow_tpu_serve_mesh_generation"] == 2)
+
+
+# ------------------------------------------- cross-process surfaces
+
+
+def test_next_retry_argv_learns_serve_flags():
+    argv = ["python", "-m", "shadow_tpu", "serve", "--max-lanes", "8",
+            "--snapshot-path", "s.npz", "--queue-file", "q.json"]
+    # peer lost: halve the lane count, carry the resume flags, and
+    # never append --resume (serve does not accept it)
+    out = next_retry_argv(argv, EXIT_PEER_LOST, shrink=True)
+    assert out[out.index("--max-lanes") + 1] == "4"
+    assert "--resume" not in out
+    assert "--snapshot-path" in out and "--queue-file" in out
+    # --max-lanes=N spelling, floored at 1
+    out = next_retry_argv(["shadow_tpu", "serve", "--max-lanes=1"],
+                          EXIT_PEER_LOST, shrink=True)
+    assert "--max-lanes=1" in out
+    # a non-shrink serve retry keeps the width
+    out = next_retry_argv(argv, 75)
+    assert out[out.index("--max-lanes") + 1] == "8"
+    assert "--resume" not in out
+    # batch argv unchanged: still gains --resume auto-if-any
+    out = next_retry_argv(["shadow_tpu", "run", "--mesh", "4"], 75)
+    assert out[-2:] == ["--resume", "auto-if-any"]
+
+
+def test_retry_wrapper_forwards_sigterm_to_child(tmp_path):
+    """SIGTERM aimed at the --retry supervisor reaches the child's
+    process group. Children run in their own sessions, so without
+    forwarding the supervisor would die and orphan the worker mid-drain
+    (and the retry report with it)."""
+    import signal
+    import sys
+    import threading
+
+    from shadow_tpu.runtime.supervisor import run_with_retry
+
+    marker = tmp_path / "drained"
+    child = [sys.executable, "-c", (
+        "import signal, sys, time\n"
+        "def bye(*a):\n"
+        f"    open({str(marker)!r}, 'w').write('ok')\n"
+        "    sys.exit(0)\n"
+        "signal.signal(signal.SIGTERM, bye)\n"
+        "sys.stderr.write('up\\n'); sys.stderr.flush()\n"
+        "for _ in range(600):\n"
+        "    time.sleep(0.1)\n")]
+    before = signal.getsignal(signal.SIGTERM)
+    timer = threading.Timer(
+        1.5, os.kill, (os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        report = run_with_retry(child, retries=0)
+    finally:
+        timer.cancel()
+    assert report["exit_code"] == 0 and report["attempts"] == 1
+    assert marker.read_text() == "ok"
+    # the supervisor restored the pre-existing handler on its way out
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_find_resume_checkpoint_refuses_serve_snapshot(tmp_path):
+    from shadow_tpu.utils.checkpoint import save_checkpoint
+    from shadow_tpu.utils import find_resume_checkpoint
+
+    path = str(tmp_path / "ck.npz")
+    st = {"a": np.arange(4, dtype=np.int64)}
+    man = {"version": 1, "class": "phold(...)", "rids": ["r000000"],
+           "seqs": [0], "docs": [_doc(1)], "beats_done": 2,
+           "beat_windows": 2, "max_lanes": 4, "stops": [500]}
+    # the serve snapshot as the ONLY candidate: a loud refusal naming
+    # the right door, not a baffling shape mismatch later
+    save_checkpoint(path, st, meta={"plane": "serve"},
+                    serve_manifest=man)
+    with pytest.raises(ValueError, match="resume_pending_batch"):
+        find_resume_checkpoint(path)
+
+    # with an older batch-run generation present, resume falls back to
+    # it and reports the serve snapshot in `skipped`
+    save_checkpoint(path + ".1", st, meta={"gen": 0})
+    os.utime(path + ".1", (1, 1))
+    chosen, meta, skipped = find_resume_checkpoint(path)
+    assert chosen == path + ".1" and meta == {"gen": 0}
+    assert [p for p, _ in skipped] == [path]
+    assert "serve" in skipped[0][1]
+
+
+def test_serve_client_bounded_connection_retry(monkeypatch):
+    from shadow_tpu.tools import serve_client as SC
+
+    class _Resp:
+        status = 200
+
+        def read(self):
+            return b'{"ok": true}'
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    calls = []
+
+    def flaky(req, timeout=0):
+        calls.append(1)
+        if len(calls) < 3:
+            raise urllib.error.URLError(ConnectionRefusedError("down"))
+        return _Resp()
+
+    monkeypatch.setattr(urllib.request, "urlopen", flaky)
+    monkeypatch.setitem(SC._RETRY, "retries", 5)
+    monkeypatch.setitem(SC._RETRY, "backoff_s", 0.0)
+    monkeypatch.setitem(SC._RETRY, "count", 0)
+    assert SC._http("http://x/healthz") == (200, {"ok": True})
+    assert len(calls) == 3 and SC._RETRY["count"] == 2
+
+    # retries=0 (the default): fail fast on the first refusal
+    calls.clear()
+    monkeypatch.setitem(SC._RETRY, "retries", 0)
+    with pytest.raises(urllib.error.URLError):
+        SC._http("http://x/healthz")
+    assert len(calls) == 1
+
+    # a non-connection error never retries, whatever the budget
+    calls.clear()
+    monkeypatch.setitem(SC._RETRY, "retries", 5)
+
+    def broken(req, timeout=0):
+        calls.append(1)
+        raise urllib.error.URLError(OSError("no route to host"))
+
+    monkeypatch.setattr(urllib.request, "urlopen", broken)
+    with pytest.raises(urllib.error.URLError):
+        SC._http("http://x/healthz")
+    assert len(calls) == 1
+
+
+# ------------------------------------------------- scenario registry
+
+
+def test_scenario_registry_hosts_without_building():
+    assert sorted(SCENARIOS) == ["bitcoin", "phold", "tgen", "tor"]
+    names, n = SCENARIOS["tgen"].hosts_of({"n_pairs": 2})
+    assert names == ["srv0", "srv1", "cli0", "cli1"] and n == 4
+    names, n = SCENARIOS["tor"].hosts_of(
+        {"n_relays_per_class": 1, "n_servers": 1, "n_clients": 2})
+    assert names == ["guard0", "middle0", "exit0", "web0",
+                     "torclient0", "torclient1"] and n == 6
+    names, n = SCENARIOS["bitcoin"].hosts_of({"n_nodes": 3})
+    assert names == ["miner0", "btc1", "btc2"] and n == 3
+
+
+_TGEN = {"model": "tgen", "params": {"n_pairs": 2, "count": 1},
+         "seed": 1, "stop_s": 2.0}
+_TOR = {"model": "tor",
+        "params": {"n_relays_per_class": 1, "n_servers": 1,
+                   "n_clients": 2, "count": 1, "filesize": "16KiB"},
+        "seed": 1, "stop_s": 2.0}
+_BTC = {"model": "bitcoin",
+        "params": {"n_nodes": 4, "blocks": 1, "blocksize": "64KiB",
+                   "interval": 5},
+        "seed": 1, "stop_s": 8.0}
+
+
+def test_config_scenarios_classify_and_validate():
+    for doc in (_TGEN, _TOR, _BTC):
+        req = _req(doc)
+        validate_request(req)
+        key = request_class(req)
+        assert str(key).startswith(doc["model"] + "(")
+        # per-lane knobs never split the class...
+        assert request_class(_req({**doc, "seed": 99})) == key
+        assert request_class(_req({**doc, "stop_s": 9.0})) == key
+        # ...static knobs do
+        bigger = {**doc, "params": {**doc["params"], "capacity": 256}}
+        assert request_class(_req(bigger)) != key
+
+    # unknown static knobs are a 400, per model
+    with pytest.raises(ValueError, match="static knobs"):
+        validate_request(_req({"model": "tgen", "params": {"warp": 1},
+                               "stop_s": 1.0}))
+    # none of the config scenarios has a NIC host tier yet
+    with pytest.raises(ValueError, match="bandwidth_scale"):
+        validate_request(_req({**_BTC, "bandwidth_scale": 0.5}))
+    # fault globs resolve against the scenario's own host names at
+    # submit time, without building
+    key = request_class(_req(
+        {**_TOR, "faults": ["crash hosts=guard0 start=0.5 end=1.0"]}))
+    assert key.fault_sig is not None
+
+
+# ----------------------------------------------- slow (real engine)
+
+
+@pytest.mark.slow  # two 1-lane fleet compiles + 2 solo oracle compiles
+def test_elastic_migration_real_engine_bit_identical(tmp_path):
+    """The ISSUE 19 acceptance pin on the REAL engine: device loss at
+    beat 2 exits 77 with the snapshot kept; a half-width relaunch
+    migrates the lane-stacked state through checkpoint numpy leaves,
+    reshards it, and finishes every request bit-identical to its solo
+    reference under the original rid."""
+    snap = str(tmp_path / "snap.npz")
+    docs = [_doc(s) for s in (931, 932)]
+    exits = []
+    svc1 = SimService(max_lanes=2, pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1, snapshot_path=snap,
+                      chaos=ServeChaos("devloss:beat=2"),
+                      peer_lost_exit=exits.append).start()
+    try:
+        rids = [svc1.submit(d)["request_id"] for d in docs]
+        deadline = time.monotonic() + 300
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        svc1.drain()
+    assert exits == [EXIT_PEER_LOST]
+    assert os.path.exists(snap)
+
+    svc2 = SimService(max_lanes=1, pack_deadline_ms=30.0, beat_windows=2,
+                      snapshot_beats=1, snapshot_path=snap,
+                      generation=1).start()
+    try:
+        assert svc2.resume_pending_batch() == 2
+        recs = _wait_done(svc2, rids)
+    finally:
+        svc2.drain()
+    assert _tot(svc2, "serve_migrations") == 1
+    for rid, d in zip(rids, docs):
+        rec = recs[rid]
+        assert rec["status"] == "done", rec
+        assert rec["summary"] == solo_reference(d)
+        assert rec["resumed_from_beat"] == 1
+
+
+@pytest.mark.slow  # three tiny fleet compiles + 3 solo oracle compiles
+def test_config_scenarios_serve_bit_identical_to_solo():
+    """Satellite gate: each registered config scenario (tgen / tor /
+    bitcoin) served through the fleet's per-lane seed binding matches
+    the natively-built solo run bit-for-bit."""
+    svc = SimService(max_lanes=2, pack_deadline_ms=100.0,
+                     beat_windows=8).start()
+    try:
+        rids = {}
+        for doc in (_TGEN, _TOR, _BTC):
+            rids[doc["model"]] = svc.submit(doc)["request_id"]
+        recs = _wait_done(svc, list(rids.values()))
+    finally:
+        svc.drain()
+    for doc in (_TGEN, _TOR, _BTC):
+        rec = recs[rids[doc["model"]]]
+        assert rec["status"] == "done", rec
+        assert rec["summary"] == solo_reference(doc), \
+            f"{doc['model']} diverged from its solo run"
