@@ -456,6 +456,20 @@ timeout 1200 env JAX_PLATFORMS=cpu \
   --donation-audit --mem-audit --output measure_dataflow.json 2>> "$S" \
   && cat measure_dataflow.json >> "$R"
 echo "=== dataflow_audit exit=$? $(date +%H:%M:%S)" >> "$S"
+# TPU-readiness gate: tile padding waste, layout churn, hot-loop
+# gather/scatter placement, merge-kernel VMEM fit, and the roofline
+# drain economics — every lowering checked against the committed
+# TPU_READINESS.json (new waste/churn/VMEM or a predicted-floor drop
+# fails the stage). Refresh deliberately with
+# `python -m shadow_tpu.tools.lint --tpu-audit all --update-baseline`.
+echo "=== tpu_readiness start $(date +%H:%M:%S)" >> "$S"
+echo "{\"stage\": \"tpu_readiness\"}" >> "$R"
+timeout 1200 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m shadow_tpu.tools.lint \
+  --tpu-audit all --output measure_tpu_readiness.json 2>> "$S" \
+  && cat measure_tpu_readiness.json >> "$R"
+echo "=== tpu_readiness exit=$? $(date +%H:%M:%S)" >> "$S"
 # sanitizer smoke: interposer + driver as one ASan/UBSan executable
 # (the dlmopen plugin path cannot host a sanitized DSO — see
 # shadow_tpu/proc/native.py SANITIZE_FLAGS)

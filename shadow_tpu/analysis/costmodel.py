@@ -1,0 +1,350 @@
+"""Roofline cost model over the lowered window loop (pre-silicon).
+
+ROADMAP item 1 asks "know which drain wins before you burn chip
+time". The measured half is `--stats` (run lengths, critical depth)
+and BENCH_r07's CPU wall times; this module is the static half: price
+ONE round of the window loop's innermost `while` body straight from
+the op graph's byte/flop math against a chip row (`analysis.chips`),
+then convert rounds/s into events/s with the measured events-per-
+inner-step ratio from the bench metadata.
+
+The model (documented with its error bars in docs/10, "TPU
+readiness"):
+
+- HBM time: every op's operand + result bytes, tile-padded for the
+  chip, once over the bus (`bytes / hbm_gbps`). Fusion makes this an
+  upper bound on traffic; treating it as fully overlapped with
+  compute (roofline max) pulls the other way.
+- VPU time: elementwise/compare/reduce flops at `vpu_gflops`;
+  `dot_general` prices on the MXU.
+- Sort time: `lax.sort` is priced separately as compare-exchanges
+  (`rows * n * ceil(log2 n)` per operand column) against the chip's
+  `sort_gcps` — the chained-vs-frontier question IS a sort-throughput
+  question (frontier's per-round sort was ~2x slower on one CPU core,
+  BENCH_r07; the VPU bet is that a vectorized bitonic network makes
+  it cheap).
+- round time = overhead + max(HBM, VPU + sort + MXU); counts scale
+  linearly from the tiny audit build to the bench topology via the
+  host-count ratio.
+
+Predicted events/s = events_per_inner_step / round_time. The winner
+per model compares the chained and frontier lowerings each under its
+own round time and its own measured events-per-inner-step. Under the
+CPU row the prediction is cross-checked for directional agreement
+with BENCH_r07's measured wall times (pinned in
+tests/test_tpu_readiness.py) — a cost model that cannot postdict the
+CPU measurement has no business predicting silicon.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+
+from shadow_tpu.analysis import hlo_graph
+from shadow_tpu.analysis.chips import CHIP_NAMES, Chip, chip as chip_row
+
+# Model -> (chained config, frontier config) drain pairs the economics
+# cover; both lower from the identical topology so the host-count
+# scale factor cancels in the comparison.
+DRAIN_PAIRS = {
+    "tor": ("tor", "tor_frontier"),
+    "tgen": ("tgen", "tgen_frontier"),
+}
+
+# Bench report carrying the measured drain economics (events,
+# inner_steps, run_s per drain). Pinned fallbacks keep the model
+# usable if the file ever moves; the numbers are BENCH_r07's.
+BENCH_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "BENCH_r07.json")
+
+_FALLBACK_BENCH = {
+    "tor": {"hosts": 1020,
+            "chained": {"events": 14293, "inner_steps": 824,
+                        "run_s": 245.11},
+            "frontier": {"events": 14293, "inner_steps": 1640,
+                         "run_s": 495.68}},
+    "tgen": {"hosts": 512,
+             "chained": {"events": 25462, "inner_steps": 400,
+                         "run_s": 43.28},
+             "frontier": {"events": 25462, "inner_steps": 778,
+                          "run_s": 86.51}},
+}
+
+_TENSOR_RE = re.compile(r"^tensor<")
+
+# Ops that move bytes but burn no arithmetic worth pricing: layout and
+# data-movement plumbing (their cost is the HBM term).
+_MOVE_OPS = {
+    "reshape", "transpose", "bitcast_convert", "broadcast_in_dim",
+    "gather", "scatter", "dynamic_slice", "dynamic_update_slice",
+    "slice", "concatenate", "iota", "constant", "convert", "reverse",
+    "pad", "tuple", "get_tuple_element", "optimization_barrier",
+    "copy", "all_to_all", "all_gather", "collective_permute",
+}
+
+
+def parse_tensor(t: str) -> tuple[list, str] | None:
+    """(dims, dtype) of one `tensor<...>` type; None for non-tensors
+    or dynamic dims. Encoding attrs after the dims are dropped the
+    same way `hlo_graph.bytes_of_type` drops them."""
+    t = t.strip()
+    if not _TENSOR_RE.match(t):
+        return None
+    end = hlo_graph._balanced(t, len("tensor"), "<", ">")
+    payload = hlo_graph._split_commas(t[len("tensor<"):end - 1])[0]
+    parts = payload.strip().split("x")
+    dims = []
+    for d in parts[:-1]:
+        if not d.isdigit():
+            return None
+        dims.append(int(d))
+    return dims, parts[-1]
+
+
+def _elems(dims: list) -> int:
+    n = 1
+    for d in dims:
+        n *= int(d)
+    return n
+
+
+def _padded_bytes(t: str, chip: Chip) -> int:
+    parsed = parse_tensor(t)
+    if parsed is None:
+        return 0
+    dims, dtype = parsed
+    eb = hlo_graph.dtype_bytes(dtype)
+    return chip.padded_bytes(dims, eb) if eb else 0
+
+
+# ------------------------------------------------- innermost while body
+
+
+def innermost_while(module: hlo_graph.Module):
+    """The deepest `while` op of the reachable graph (ties broken by
+    body size) and the func that owns it — the drain round the model
+    prices. Returns (op, func) or (None, None)."""
+    best, best_func, best_key = None, None, (-1, -1)
+
+    def _scan(region, depth, func):
+        nonlocal best, best_func, best_key
+        for op in region.ops:
+            if op.short == "while":
+                body = next((r for r in op.regions if r.label == "do"),
+                            None)
+                n_ops = sum(1 for _ in body.walk()) if body else 0
+                if (depth, n_ops) > best_key:
+                    best, best_func, best_key = op, func, (depth, n_ops)
+            for r in op.regions:
+                _scan(r, depth + (1 if op.short == "while" else 0), func)
+
+    for f in module.reachable_funcs():
+        _scan(f.body, 0, f)
+    return best, best_func
+
+
+def _type_env(func: hlo_graph.Func) -> dict[str, str]:
+    """SSA name -> type over one func (single-result ops and block
+    args; multi-result groups stay unresolved — estimates degrade to
+    'saw less', never crash)."""
+    env: dict[str, str] = {}
+    for name, t, _a in func.args:
+        env[name] = t
+    for op in func.walk():
+        if op.result is not None and op.n_results == 1 \
+                and op.result_types:
+            env[op.result] = op.result_types[0]
+        for r in op.regions:
+            for n, t in r.block_args:
+                env.setdefault(n, t)
+    return env
+
+
+def price_region(region: hlo_graph.Region, env: dict[str, str],
+                 chip: Chip) -> dict:
+    """Byte/flop/compare counts of one execution of `region`.
+
+    Nested non-while regions (sort comparators, reducers) are priced
+    through their owning op's formula, not op-by-op; a nested while is
+    priced as one round of its own body (the model prices rounds, not
+    trip counts)."""
+    out = {"bytes": 0, "vpu_flops": 0, "sort_compares": 0,
+           "mxu_flops": 0}
+
+    def _add(d):
+        for k in out:
+            out[k] += d[k]
+
+    for op in region.ops:
+        if op.dialect not in ("stablehlo", "mhlo", "chlo"):
+            continue
+        rbytes = sum(_padded_bytes(t, chip) for t in op.result_types)
+        obytes = sum(_padded_bytes(env.get(o, ""), chip)
+                     for o in op.operands)
+        out["bytes"] += rbytes + obytes
+        short = op.short
+        if short == "while":
+            body = next((r for r in op.regions if r.label == "do"),
+                        None)
+            if body is not None:
+                _add(price_region(body, env, chip))
+            continue
+        if short in ("case", "if"):
+            for r in op.regions:
+                _add(price_region(r, env, chip))
+            continue
+        first = parse_tensor(op.result_types[0]) \
+            if op.result_types else None
+        if first is None:
+            continue
+        dims, _dtype = first
+        elems = _elems(dims)
+        if short == "sort":
+            n = dims[-1] if dims else 1
+            rows = _elems(dims[:-1])
+            per_col = rows * n * max(1, math.ceil(math.log2(max(n, 2))))
+            out["sort_compares"] += per_col * max(op.n_results, 1)
+        elif short == "dot_general":
+            k = 1
+            if op.operands:
+                lhs = parse_tensor(env.get(op.operands[0], ""))
+                if lhs is not None and lhs[0]:
+                    k = lhs[0][-1]
+            out["mxu_flops"] += 2 * elems * k
+        elif short in ("reduce", "reduce_window"):
+            ops_in = sum(
+                _elems(p[0]) for p in
+                (parse_tensor(env.get(o, "")) for o in op.operands)
+                if p is not None)
+            out["vpu_flops"] += max(ops_in, elems)
+        elif short not in _MOVE_OPS:
+            # elementwise / compare / select / rng default: one lane
+            # op per result element
+            out["vpu_flops"] += elems
+    return out
+
+
+def round_time_s(counts: dict, chip: Chip, scale: float = 1.0) -> dict:
+    """Roofline time of one round: overhead + max(memory, compute)."""
+    b = counts["bytes"] * scale
+    hbm_s = b / (chip.hbm_gbps * 1e9)
+    vpu_s = counts["vpu_flops"] * scale / (chip.vpu_gflops * 1e9)
+    sort_s = counts["sort_compares"] * scale / (chip.sort_gcps * 1e9)
+    mxu_s = (counts["mxu_flops"] * scale / (chip.mxu_tflops * 1e12)
+             if chip.mxu_tflops else 0.0)
+    compute_s = vpu_s + sort_s + mxu_s
+    total = chip.round_overhead_us * 1e-6 + max(hbm_s, compute_s)
+    return {
+        "round_us": total * 1e6,
+        "bound": ("hbm" if hbm_s > compute_s else
+                  "sort" if sort_s >= vpu_s + mxu_s else "vpu"),
+    }
+
+
+def price_module(module: hlo_graph.Module, chip_name: str,
+                 scale: float = 1.0) -> dict | None:
+    """Round counts + roofline time of a lowered program's drain round
+    under one chip row; None when no while loop exists."""
+    op, func = innermost_while(module)
+    if op is None:
+        return None
+    body = next((r for r in op.regions if r.label == "do"), None)
+    if body is None:
+        return None
+    c = chip_row(chip_name)
+    counts = price_region(body, _type_env(func), c)
+    timing = round_time_s(counts, c, scale)
+    return {**counts, **timing, "scale": round(scale, 3)}
+
+
+# --------------------------------------------------- bench ground truth
+
+
+def bench_drain_metadata(path: str | None = None) -> dict:
+    """Measured drain economics per model from the bench report:
+    {"tor": {"hosts", "chained": {events, inner_steps, run_s},
+    "frontier": {...}}, ...}. Falls back to BENCH_r07's pinned numbers
+    when the report is absent."""
+    path = BENCH_PATH if path is None else path
+    if not os.path.exists(path):
+        return _FALLBACK_BENCH
+    with open(path, "r", encoding="utf-8") as fh:
+        parsed = json.load(fh).get("parsed", {})
+    out = {}
+    for model in DRAIN_PAIRS:
+        entry = {}
+        for drain in ("chained", "frontier"):
+            rec = parsed.get(f"{model}_{drain}")
+            if rec is None:
+                break
+            entry[drain] = {
+                "events": rec[f"{model}_events"],
+                "inner_steps": rec[f"{model}_inner_steps"],
+                "run_s": rec[f"{model}_profile"]["run_s"],
+            }
+            entry["hosts"] = rec[f"{model}_hosts"]
+        if len(entry) == 3:
+            out[model] = entry
+    return out or _FALLBACK_BENCH
+
+
+def drain_report(modules: dict, hosts: dict,
+                 bench: dict | None = None,
+                 chips: tuple = CHIP_NAMES) -> dict:
+    """Chained-vs-frontier economics per model per chip.
+
+    `modules` maps config name -> parsed Module for every config in
+    DRAIN_PAIRS; `hosts` maps config name -> host count of the tiny
+    audit build (the linear scale-up target is the bench topology's
+    host count). Returns per-model predictions, winners, the measured
+    CPU winner, and whether the CPU-row prediction agrees with it.
+    """
+    bench = bench_drain_metadata() if bench is None else bench
+    out: dict = {}
+    for model, (cfg_c, cfg_f) in DRAIN_PAIRS.items():
+        meta = bench.get(model)
+        if meta is None or cfg_c not in modules or cfg_f not in modules:
+            continue
+        epr = {
+            "chained": meta["chained"]["events"]
+            / max(meta["chained"]["inner_steps"], 1),
+            "frontier": meta["frontier"]["events"]
+            / max(meta["frontier"]["inner_steps"], 1),
+        }
+        measured = ("chained"
+                    if meta["chained"]["run_s"]
+                    <= meta["frontier"]["run_s"] else "frontier")
+        rec: dict = {
+            "events_per_round": {k: round(v, 2) for k, v in epr.items()},
+            "measured_cpu_winner": measured,
+            "per_chip": {}, "winner": {},
+        }
+        for cname in chips:
+            per = {}
+            for drain, cfg in (("chained", cfg_c), ("frontier", cfg_f)):
+                scale = meta["hosts"] / max(hosts.get(cfg, 1), 1)
+                priced = price_module(modules[cfg], cname, scale)
+                if priced is None:
+                    per = {}
+                    break
+                per[drain] = {
+                    "round_us": round(priced["round_us"], 3),
+                    "bound": priced["bound"],
+                    "events_per_s": round(
+                        epr[drain] / (priced["round_us"] * 1e-6), 1),
+                }
+            if not per:
+                continue
+            rec["per_chip"][cname] = per
+            rec["winner"][cname] = (
+                "chained" if per["chained"]["events_per_s"]
+                >= per["frontier"]["events_per_s"] else "frontier")
+        if "cpu" in rec["winner"]:
+            rec["cpu_agrees_with_bench"] = \
+                rec["winner"]["cpu"] == measured
+        out[model] = rec
+    return out

@@ -169,6 +169,13 @@ CONTRACTS: dict[str, HloContract] = {
     # into per-lane bookkeeping writes.
     "phold_fleet": HloContract("phold_fleet", _budget(0)),
     "tgen_fleet": HloContract("tgen_fleet", _budget(11)),
+    # The serve warm path (ISSUE 17 resident serving): the fleet's
+    # fixed-window lane step under per-lane stops — the program
+    # `Fleet.step_window` jits once and the service re-invokes per
+    # request batch via `make_inputs`. Budget pinned equal to the
+    # phold fleet contract: giving each lane its own traced stop adds
+    # one vmap axis on a scalar, which must add NO scatter.
+    "phold_serve": HloContract("phold_serve", _budget(0)),
     # The SPMD lowering of the raw PHOLD window loop over an 8-device
     # mesh. Every count is structural (per traced site x per Events
     # leaf), none scale with hosts or events:
@@ -228,6 +235,25 @@ def _build(name: str):
             eng, init(), 4, seeds=(0, 1, 2, 3)
         )
         return fleet.run_fn(), fleet.state0, jnp.int64(5_000_000_000)
+
+    if name == "phold_serve":
+        from shadow_tpu.models import phold
+        from shadow_tpu.runtime.fleet import Fleet, FleetPlan
+
+        eng, init = phold.build(8, seed=3, capacity=32, msgs_per_host=2)
+        fleet = Fleet(eng, init(), FleetPlan(lanes=4, seeds=(0, 1, 2, 3)),
+                      per_lane_stop=True)
+        # the warm-path program: the fixed-window lane step the serving
+        # plane re-invokes per packed batch (Fleet.step_window's
+        # `_jit_step_fixed`), with per-lane [L] stops traced in
+        import jax
+
+        _, lane_step = fleet._make_lane_fns()
+        stepped = jax.vmap(lambda s, bi, t: lane_step(s, bi, t, None),
+                           in_axes=(0, 0, 0))
+        binds = fleet.binds
+        run = lambda st, stop: stepped(st, binds, stop)  # noqa: E731
+        return run, fleet.state0, jnp.full((4,), jnp.int64(5_000_000_000))
 
     if name == "tgen_fleet":
         from shadow_tpu import examples

@@ -228,6 +228,23 @@ class Module:
                            "custom_call", reachable_only=reachable_only)
                        if op.custom_target})
 
+    def ops_with_path(self) -> Iterator[tuple[Op, str]]:
+        """(op, region path) over reachable funcs. The path names every
+        enclosing op region, e.g. ``main/while@12.do/while@40.do`` —
+        a path containing ``while@N.do`` places the op inside the
+        window loop's hot path, and the tail says exactly where (the
+        tile/placement auditor's provenance string)."""
+        def _walk(region: Region, prefix: str) -> Iterator[tuple[Op, str]]:
+            for op in region.ops:
+                yield op, prefix
+                for i, r in enumerate(op.regions):
+                    label = r.label or str(i)
+                    yield from _walk(
+                        r, f"{prefix}/{op.short}@{op.line}.{label}")
+
+        for f in self.reachable_funcs():
+            yield from _walk(f.body, f.name)
+
     def while_body_ops(self) -> Iterator[Op]:
         """Ops inside any while body ("do" region) — the structural
         form of "in the window loop's hot path"."""
@@ -246,11 +263,16 @@ _OPNAME_BARE_RE = re.compile(r"^([A-Za-z_][\w$]*\.[A-Za-z_][\w$]*)\b")
 _ITER_RE = re.compile(r"(%iterArg\w*)\s*=\s*(%\w+)")
 _VALUE_RE = re.compile(r"%([A-Za-z0-9_]+)")
 _BLOCK_ARG_RE = re.compile(r"(%[A-Za-z0-9_]+):\s*([^,()]+)")
-_CALLEE_RE = re.compile(r'@(?:"([^"]+)"|([\w.$-]+))')
-_TARGET_NAME_RE = re.compile(r'call_target_name\s*=\s*"([^"]+)"')
-_RESULT_INFO_RE = re.compile(r'jax\.result_info\s*=\s*"([^"]*)"')
+# quoted names may carry escaped characters (`@"a\"b"`): a string
+# atom is any run of non-quote/non-backslash chars or escape pairs
+_QSTR = r'(?:[^"\\]|\\.)'
+_CALLEE_RE = re.compile(r'@(?:"(' + _QSTR + r'+)"|([\w.$-]+))')
+_TARGET_NAME_RE = re.compile(
+    r'call_target_name\s*=\s*"(' + _QSTR + r'+)"')
+_RESULT_INFO_RE = re.compile(
+    r'jax\.result_info\s*=\s*"(' + _QSTR + r'*)"')
 _FUNC_RE = re.compile(r"^func\.func\s+(?:(public|private)\s+)?@"
-                      r'(?:"([^"]+)"|([\w.$-]+))\s*\(')
+                      r'(?:"(' + _QSTR + r'+)"|([\w.$-]+))\s*\(')
 
 
 def _balanced(s: str, start: int, open_c: str, close_c: str) -> int:

@@ -88,6 +88,18 @@ way those disciplines have been (or nearly were) broken:
   (obs/server.py, serve/http.py): blocking socket work lives ONLY on
   ThreadingHTTPServer handler threads; the drive path never touches a
   socket.
+- SL114 shared-attribute mutation in a thread-entry scope without the
+  instance lock — `do_<VERB>` HTTP handler methods run one per request
+  thread, and any method passed as ``threading.Thread(target=...)``
+  runs concurrently with the submitting thread. Writing state other
+  threads read (`self.attr` in a lock-owning worker class; anything
+  reached through ``self.<obj>.<attr>`` from a per-request handler)
+  outside a ``with self._lock:`` block is a data race the serving
+  plane's discipline (serve/service.py, obs/servetrace.py,
+  obs/server.py) already forbids. Code lexically under a ``with`` on a
+  lock-ish attribute (``*lock*``/``*cond*``/``*mutex*``), methods
+  named ``*_locked`` (caller holds it), and the lock attributes
+  themselves are exempt.
 
 Findings carry a stable key (rule | relpath | enclosing function |
 stripped source line) so the baseline survives unrelated line drift.
@@ -118,6 +130,8 @@ RULES = {
     "SL111": "donated buffer double-donated or reused after donation",
     "SL112": "computed-index gather of a global host table in handler scope",
     "SL113": "blocking socket/HTTP call on the jit or window-dispatch path",
+    "SL114": "shared-attribute mutation in thread-entry scope without "
+             "the instance lock",
 }
 
 # SL112: names under which model handlers receive the global config
@@ -152,6 +166,28 @@ _BLOCKING_SOCKET_ATTRS = {
 # window-loop drive scopes: the engine/fleet state-threading entry
 # points plus the segment-dispatch site of the run loop
 _DISPATCH_SCOPES = {"run", "step_window", "dispatch"}
+
+# SL114: thread-entry scopes and the lock discipline they must follow.
+# `do_<VERB>` methods run one per ThreadingHTTPServer request thread;
+# methods named as a `threading.Thread(target=...)` (pass 1) run
+# concurrently with the thread that spawned them.
+_HTTP_VERB_RE = re.compile(r"^do_[A-Z]+$")
+# attributes that ARE the synchronization (with self._lock: /
+# self._cond: / self._scrape_lock:) — both the exemption context and
+# excluded as mutation targets
+_LOCKISH_RE = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+# constructors whose result makes a class "lock-owning" when assigned
+# to a self attribute anywhere in the class body
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+# container mutators that write through an attribute chain. "set" is
+# deliberately absent — `self.metrics.set(...)`-style gauge APIs are
+# value setters on objects that do their own locking, and the single
+# word collides with far too many benign APIs.
+_SL114_MUTATORS = {
+    "append", "extend", "insert", "remove", "clear", "update",
+    "setdefault", "add", "discard", "popleft", "appendleft",
+}
 
 # SL107: callables by these names are window-loop entry points (the
 # engine's state-threading convention), and parameters by these names
@@ -330,6 +366,12 @@ class _Linter(ast.NodeVisitor):
         # was consumed by a donated call (name -> consuming call)
         self._donating: list[dict[str, set[int]]] = [{}]
         self._donate_consumed: list[dict[str, ast.Call]] = [{}]
+        # SL114: method names passed as Thread(target=...) (pass 1),
+        # the lock-attr sets of enclosing classes, and the lexical
+        # `with <lock>:` nesting depth
+        self.thread_marked: set[str] = set()
+        self._class_locks: list[set[str]] = []
+        self._lock_depth = 0
 
     # ------------------------------------------------------------ utils
 
@@ -412,8 +454,25 @@ class _Linter(ast.NodeVisitor):
                            f"mutable default `{_unparse(d)}` in "
                            f"{node.name}() is shared across calls; use "
                            f"None + in-body construction (or a tuple)")
-        self.scopes.append(_Scope(node.name, jitted, params,
-                                  predicate=node.name in self.pred_marked))
+        scope = _Scope(node.name, jitted, params,
+                       predicate=node.name in self.pred_marked)
+        # SL114: a do_<VERB> method or a Thread-target method is a
+        # thread-entry scope; nested defs inherit it (closures run on
+        # the same thread). `*_locked` methods document that the
+        # caller already holds the lock.
+        scope.sl114 = next(
+            (getattr(s, "sl114", None) for s in reversed(self.scopes)
+             if getattr(s, "sl114", None)), None)
+        if scope.sl114 is None \
+                and getattr(self._scope, "is_class", False):
+            locks = self._class_locks[-1] if self._class_locks else set()
+            if _HTTP_VERB_RE.match(node.name):
+                scope.sl114 = ("handler", locks)
+            elif node.name in self.thread_marked:
+                scope.sl114 = ("worker", locks)
+        if node.name.endswith("_locked"):
+            scope.sl114 = None
+        self.scopes.append(scope)
         self._prng_uses.append({})
         self._donating.append({})
         self._donate_consumed.append({})
@@ -451,7 +510,21 @@ class _Linter(ast.NodeVisitor):
                            f"mutable class-body default `{_unparse(val)}` "
                            f"in {node.name} is shared by every instance; "
                            f"use dataclasses.field(default_factory=...)")
-        self.scopes.append(_Scope(node.name, False, set()))
+        scope = _Scope(node.name, False, set())
+        scope.is_class = True
+        # SL114: lock attributes the class owns (self.X = Lock() /
+        # Condition() / ... anywhere in its body)
+        locks: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _call_basename(sub.value.func) in _LOCK_CTORS:
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and _attr_root(t) == "self":
+                        locks.add(t.attr)
+        self.scopes.append(scope)
+        self._class_locks.append(locks)
         self._prng_uses.append({})
         self._donating.append({})
         self._donate_consumed.append({})
@@ -459,6 +532,7 @@ class _Linter(ast.NodeVisitor):
         self._prng_uses.pop()
         self._donating.pop()
         self._donate_consumed.pop()
+        self._class_locks.pop()
         self.scopes.pop()
 
     @staticmethod
@@ -559,6 +633,10 @@ class _Linter(ast.NodeVisitor):
 
         # SL104: collect PRNG consumer uses
         self._track_prng(node)
+
+        # SL114: container mutation through a shared chain in a
+        # thread-entry scope
+        self._check_sl114_call(node)
 
         # SL111: donation hazards at the call site. Consumption is
         # registered only AFTER the call's own arguments are visited,
@@ -854,6 +932,9 @@ class _Linter(ast.NodeVisitor):
                                f"timebase.TIME_DTYPE")
 
     def visit_Assign(self, node: ast.Assign) -> None:
+        # SL114: shared-attribute store in a thread-entry scope
+        for tgt in node.targets:
+            self._check_sl114_store(tgt, node)
         # SL103: timey_name = jnp.zeros(..., dtype=int32)-style constructions
         if isinstance(node.value, ast.Call):
             for kw in node.value.keywords:
@@ -1000,6 +1081,110 @@ class _Linter(ast.NodeVisitor):
                 f"lookup is intended, suppress with a reason")
         self.generic_visit(node)
 
+    # ---------------------------------------------------- SL114 threads
+
+    def _sl114_ctx(self):
+        """(kind, class_locks) when the current scope is a thread-entry
+        scope and the write is not under a lock; None otherwise."""
+        if self._lock_depth:
+            return None
+        for s in reversed(self.scopes):
+            ctx = getattr(s, "sl114", None)
+            if ctx:
+                return ctx
+        return None
+
+    @staticmethod
+    def _is_lockish(expr: ast.AST) -> bool:
+        """`with self._lock:` / `with self._cond:` / `with lock:` —
+        also through chains (`self.service._lock`)."""
+        if isinstance(expr, ast.Call):  # acquire_timeout()-style helpers
+            expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            return bool(_LOCKISH_RE.search(expr.attr))
+        if isinstance(expr, ast.Name):
+            return bool(_LOCKISH_RE.search(expr.id))
+        return False
+
+    @staticmethod
+    def _self_chain(node: ast.AST) -> list[str] | None:
+        """Attribute names of a chain rooted at `self`, outermost last;
+        None for non-self targets. Subscripts are transparent: storing
+        to `self.a.b[k]` mutates the shared `self.a.b`."""
+        attrs: list[str] = []
+        while True:
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            elif isinstance(node, ast.Attribute):
+                attrs.append(node.attr)
+                node = node.value
+            else:
+                break
+        if isinstance(node, ast.Name) and node.id == "self" and attrs:
+            return list(reversed(attrs))
+        return None
+
+    def _check_sl114_store(self, target: ast.AST, node: ast.AST) -> None:
+        ctx = self._sl114_ctx()
+        if ctx is None:
+            return
+        kind, locks = ctx
+        chain = self._self_chain(target)
+        if not chain or any(_LOCKISH_RE.search(a) for a in chain):
+            return
+        dotted = "self." + ".".join(chain)
+        if len(chain) >= 2:
+            # a handler/worker writing through self.<obj>.<attr>
+            # mutates an object every other request thread shares
+            self._emit(
+                "SL114", node,
+                f"`{dotted}` written in thread-entry scope "
+                f"`{self._scope.name}` mutates a shared object without "
+                f"the instance lock; wrap in `with ...lock:` (or move "
+                f"the write behind a `*_locked` method)")
+        elif kind == "worker" and locks:
+            # a Thread-target method of a lock-owning class: every
+            # bare self write races the submitting thread
+            self._emit(
+                "SL114", node,
+                f"`{dotted}` written in worker-thread scope "
+                f"`{self._scope.name}` outside "
+                f"`with self.{sorted(locks)[0]}:` — the class owns a "
+                f"lock precisely so worker-visible state is only "
+                f"touched under it")
+
+    def _check_sl114_call(self, node: ast.Call) -> None:
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _SL114_MUTATORS:
+            return
+        ctx = self._sl114_ctx()
+        if ctx is None:
+            return
+        kind, locks = ctx
+        chain = self._self_chain(node.func.value)
+        if not chain or any(_LOCKISH_RE.search(a) for a in chain):
+            return
+        if len(chain) >= 2 or (kind == "worker" and locks):
+            dotted = "self." + ".".join(chain)
+            self._emit(
+                "SL114", node,
+                f"`{dotted}.{node.func.attr}(...)` mutates shared "
+                f"state in thread-entry scope `{self._scope.name}` "
+                f"without the instance lock; wrap in `with ...lock:`")
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(self._is_lockish(item.context_expr)
+                      for item in node.items)
+        if lockish:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if lockish:
+            self._lock_depth -= 1
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_sl114_store(node.target, node)
+        self.generic_visit(node)
+
 
 class _JitMarker(ast.NodeVisitor):
     """Pass 1: collect names referenced as callee arguments of jit
@@ -1011,6 +1196,8 @@ class _JitMarker(ast.NodeVisitor):
         self.func_params: dict[str, tuple[str, ...]] = {}
         # names passed as while_loop's cond_fun — predicate scope (SL108)
         self.pred_marked: set[str] = set()
+        # names passed as Thread(target=...) — thread-entry scope (SL114)
+        self.thread_targets: set[str] = set()
 
     def _visit_funcdef(self, node) -> None:
         a = node.args
@@ -1022,6 +1209,13 @@ class _JitMarker(ast.NodeVisitor):
     visit_AsyncFunctionDef = _visit_funcdef
 
     def visit_Call(self, node: ast.Call) -> None:
+        if _call_basename(node.func) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    if isinstance(kw.value, ast.Attribute):
+                        self.thread_targets.add(kw.value.attr)
+                    elif isinstance(kw.value, ast.Name):
+                        self.thread_targets.add(kw.value.id)
         if _call_basename(node.func) == "while_loop":
             tgt = node.args[0] if node.args else None
             for kw in node.keywords:
@@ -1070,6 +1264,7 @@ def lint_source(src: str, path: str = "<string>") -> list[Finding]:
     linter.jit_marked = marker.marked
     linter.func_params = marker.func_params
     linter.pred_marked = marker.pred_marked
+    linter.thread_marked = marker.thread_targets
     linter.visit(tree)
     return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
 

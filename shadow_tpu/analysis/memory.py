@@ -53,7 +53,7 @@ FLEET = 4
 
 MEM_CONFIGS = ("phold", "phold_net", "tgen", "tor", "bitcoin",
                "tgen_frontier", "tor_frontier", "bitcoin_frontier",
-               "phold_fleet", "tgen_fleet")
+               "phold_fleet", "tgen_fleet", "phold_serve")
 
 
 # ------------------------------------------------------------ liveness

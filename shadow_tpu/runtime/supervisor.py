@@ -210,7 +210,7 @@ class Watchdog:
                 if self.compile_grace and self._main_thread_compiling():
                     with self._lock:
                         self._last_pet = time.monotonic()
-                    self.compile_graces += 1
+                        self.compile_graces += 1
                     print(
                         f"{self.label}: {self.kind} deadline extended — "
                         f"main thread is compiling "

@@ -8,11 +8,14 @@
     python -m shadow_tpu.tools.lint --donation-audit # alias verifier
     python -m shadow_tpu.tools.lint --mem-audit      # peak-live budgets
     python -m shadow_tpu.tools.lint --mem-audit --update-baseline
+    python -m shadow_tpu.tools.lint --tpu-audit all  # readiness gate
+    python -m shadow_tpu.tools.lint --tpu-audit all --update-baseline
     python -m shadow_tpu.tools.lint --diff old.json new.json
 
 Exit status: 0 when there are no findings outside the checked-in
-baseline (and, with --hlo-audit / --donation-audit / --mem-audit,
-every audited config meets its contract); 1 otherwise. Output is a
+baseline (and, with --hlo-audit / --donation-audit / --mem-audit /
+--tpu-audit, every audited config meets its contract); 1 otherwise.
+Output is a
 single JSON document on stdout — machine-readable for the
 measure_all.sh lint and dataflow_audit stages — with human one-liners
 on stderr.
@@ -22,10 +25,14 @@ findings keyed by (rule | path | function | source line) so they
 survive line drift; stale entries are reported (not fatal) so the
 baseline shrinks as findings are fixed. `--mem-audit
 --update-baseline` refreshes the peak-live budgets
-(shadow_tpu/analysis/MEM_BUDGETS.json) the same way. `--diff`
-compares two saved JSON reports and prints the per-config drift of op
-budgets, alias counts, and memory estimates — the review artifact for
-an intentional budget bump. See docs/10-Static-Analysis.md.
+(shadow_tpu/analysis/MEM_BUDGETS.json) the same way, and `--tpu-audit
+--update-baseline` the TPU-readiness baseline
+(shadow_tpu/analysis/TPU_READINESS.json). `--diff` compares two saved
+JSON reports and prints the per-config drift of op budgets, alias
+counts, memory estimates, and TPU-readiness numbers (tile waste,
+layout churn, merge-kernel VMEM, predicted events/s floors) — the
+review artifact for an intentional budget bump. See
+docs/10-Static-Analysis.md.
 """
 
 from __future__ import annotations
@@ -44,7 +51,9 @@ def _diff_reports(old: dict, new: dict) -> list[str]:
     def _num(section: str, cfg: str, key: str, a, b) -> None:
         if a != b and isinstance(a, (int, float)) \
                 and isinstance(b, (int, float)):
-            lines.append(f"{section} {cfg}: {key} {a} -> {b} ({b - a:+d})")
+            d = b - a
+            delta = f"{d:+d}" if isinstance(d, int) else f"{d:+.2f}"
+            lines.append(f"{section} {cfg}: {key} {a} -> {b} ({delta})")
 
     oh, nh = old.get("hlo_audit", {}), new.get("hlo_audit", {})
     for cfg in sorted(set(oh) | set(nh)):
@@ -65,6 +74,50 @@ def _diff_reports(old: dict, new: dict) -> list[str]:
         ne = nm.get(cfg, {}).get("estimate", {})
         for key in ("args_bytes", "carry_bytes", "peak_bytes"):
             _num("memory", cfg, key, oe.get(key, 0), ne.get(key, 0))
+
+    # tpu_readiness: waste / churn / VMEM / predicted-floor drift per
+    # config, plus per-chip winner flips in the drain economics
+    ot, nt = old.get("tpu_readiness", {}), new.get("tpu_readiness", {})
+    for cfg in sorted((set(ot) | set(nt)) - {"drain_economics"}):
+        oc, nc = ot.get(cfg, {}), nt.get(cfg, {})
+        _num("tpu", cfg, "tile.waste_pct",
+             oc.get("tile", {}).get("waste_pct", 0),
+             nc.get("tile", {}).get("waste_pct", 0))
+        _num("tpu", cfg, "tile.padded_bytes",
+             oc.get("tile", {}).get("padded_bytes", 0),
+             nc.get("tile", {}).get("padded_bytes", 0))
+        och, nch = oc.get("churn", {}), nc.get("churn", {})
+        for op in sorted(set(och) | set(nch)):
+            for key in ("count", "hot"):
+                _num("tpu", cfg, f"churn.{op}.{key}",
+                     och.get(op, {}).get(key, 0),
+                     nch.get(op, {}).get(key, 0))
+        op_, np_ = oc.get("placement", {}), nc.get("placement", {})
+        for op in sorted(set(op_) | set(np_)):
+            _num("tpu", cfg, f"hot.{op}",
+                 op_.get(op, {}).get("hot", 0),
+                 np_.get(op, {}).get("hot", 0))
+        ov = oc.get("vmem") or {}
+        nv = nc.get("vmem") or {}
+        _num("tpu", cfg, "vmem.working_set_bytes",
+             ov.get("working_set_bytes", 0),
+             nv.get("working_set_bytes", 0))
+        of_, nf = oc.get("floors", {}), nc.get("floors", {})
+        for cn in sorted(set(of_) | set(nf)):
+            _num("tpu", cfg, f"floor.{cn}",
+                 of_.get(cn, 0), nf.get(cn, 0))
+    oe_, ne_ = (ot.get("drain_economics", {}),
+                nt.get("drain_economics", {}))
+    for model in sorted((set(oe_) | set(ne_))
+                        - {"ok", "violations"}):
+        ow = oe_.get(model, {}).get("winner", {})
+        nw = ne_.get(model, {}).get("winner", {})
+        for cn in sorted(set(ow) | set(nw)):
+            a, b = ow.get(cn), nw.get(cn)
+            if a != b:
+                lines.append(
+                    f"tpu drain_economics {model}: {cn} winner "
+                    f"{a} -> {b}")
     return lines
 
 
@@ -91,6 +144,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--mem-audit", action="store_true",
                     help="estimate peak-live bytes per config and check "
                          "against MEM_BUDGETS.json")
+    ap.add_argument("--tpu-audit", metavar="CONFIGS", default=None,
+                    help="TPU-readiness audit (tile waste, layout "
+                         "churn, hot-loop placement, merge-kernel VMEM, "
+                         "roofline drain economics) checked against "
+                         "TPU_READINESS.json: 'all' or a comma list of "
+                         "configs")
     ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                     default=None,
                     help="compare two saved JSON reports and print the "
@@ -131,6 +190,16 @@ def main(argv: list[str] | None = None) -> int:
             M.save_budgets(ests)
             print(f"mem baseline: {len(ests)} budgets -> "
                   f"{M.BUDGETS_PATH}", file=sys.stderr)
+        if args.tpu_audit:
+            from shadow_tpu.analysis import tpu_readiness as T
+
+            names = (None if args.tpu_audit == "all"
+                     else [n.strip() for n in args.tpu_audit.split(",")
+                           if n.strip()])
+            results = T.audit_all(names)
+            data = T.save_baseline(results)
+            print(f"tpu baseline: {len(data['configs'])} configs -> "
+                  f"{T.BASELINE_PATH}", file=sys.stderr)
         return 0
 
     baseline = {} if args.no_baseline else L.load_baseline(args.baseline)
@@ -187,6 +256,20 @@ def main(argv: list[str] | None = None) -> int:
                 failed = True
                 for v in res["violations"]:
                     print(f"mem_audit: {v}", file=sys.stderr)
+
+    if args.tpu_audit:
+        from shadow_tpu.analysis import tpu_readiness as T
+
+        names = (None if args.tpu_audit == "all"
+                 else [n.strip() for n in args.tpu_audit.split(",")
+                       if n.strip()])
+        tpu = T.audit_all(names)
+        report["tpu_readiness"] = tpu
+        for name, res in tpu.items():
+            if not res["ok"]:
+                failed = True
+                for v in res["violations"]:
+                    print(f"tpu_audit: {v}", file=sys.stderr)
 
     for f in new:
         print(str(f), file=sys.stderr)

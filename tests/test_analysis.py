@@ -600,6 +600,89 @@ def test_sl113_inline_suppression():
     assert fs == []
 
 
+def test_sl114_worker_write_without_lock():
+    # a Thread-target method of a lock-owning class writing bare self
+    # state races the submitting thread (the supervisor.py
+    # compile_graces bug this rule was built from)
+    fs = _lint("""
+        import threading
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self.jobs = []
+                threading.Thread(target=self._worker_loop).start()
+            def _worker_loop(self):
+                self.count += 1
+                self.jobs.append("x")
+    """)
+    assert _rules(fs) == ["SL114"]
+    assert len(fs) == 2  # the augassign and the container mutation
+
+
+def test_sl114_lock_scope_and_locked_suffix_exempt():
+    # the serving discipline: writes under `with self._lock:` / inside
+    # a `with self._cond:` wait loop are clean, and `*_locked` methods
+    # document that their caller already holds it
+    fs = _lint("""
+        import threading
+        class Svc:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.count = 0
+                threading.Thread(target=self._worker_loop).start()
+            def _worker_loop(self):
+                with self._cond:
+                    self.count += 1
+                    self._drain_locked()
+            def _drain_locked(self):
+                self.count = 0
+    """)
+    assert fs == []
+
+
+def test_sl114_handler_shared_chain():
+    # a per-request do_* handler mutating the object every request
+    # thread shares (the service/server behind the handler) must hold
+    # its lock; bare handler attributes are per-request state and the
+    # local dict mutation never flags
+    fs = _lint("""
+        class Handler:
+            def do_POST(self):
+                self.close_connection = True
+                doc = {}
+                doc.update(status="ok")
+                self.service.total += 1
+                self.service.log.append("x")
+            def do_GET(self):
+                with self.service._lock:
+                    self.service.total += 1
+    """)
+    assert _rules(fs) == ["SL114"]
+    assert len(fs) == 2
+    assert all(f.func == "Handler.do_POST" for f in fs)
+
+
+def test_sl114_silent_outside_thread_entry_and_suppression():
+    # plain methods (not do_*, never a Thread target) are unchecked
+    # even in lock-owning classes — single-threaded mutation is the
+    # default — and the inline marker works where a handler write is
+    # deliberate (e.g. the object does its own internal locking)
+    fs = _lint("""
+        import threading
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def bump(self):
+                self.count += 1
+        class Handler:
+            def do_GET(self):
+                self.tracer.spans.append("x")  # shadowlint: disable=SL114
+    """)
+    assert fs == []
+
+
 def test_inline_suppression():
     fs = _lint("""
         from shadow_tpu.core import rng as srng

@@ -203,3 +203,122 @@ def test_roundtrip_harvest_program():
     hist = m.histogram()
     for op in ("infeed", "outfeed", "send", "recv"):
         assert hist.get(op, 0) == 0  # extraction never crosses to host
+
+
+# ------------------------------------------------- adversarial fixtures
+# Fuzz-style texts pinning the parser the whole TPU-readiness tentpole
+# stands on: nesting depth, strings that contain the grammar's own
+# delimiters, dense<...> literals inside attributes, zero-result ops.
+
+
+_DEEP = """\
+module @deep {
+  func.func public @main(%arg0: tensor<i64>, %arg1: tensor<4x4xi64>) -> tensor<i64> {
+    %0 = stablehlo.while(%iterArg = %arg0) : tensor<i64>
+     cond {
+      %1 = stablehlo.compare  LT, %iterArg, %iterArg : (tensor<i64>, tensor<i64>) -> tensor<i1>
+      stablehlo.return %1 : tensor<i1>
+    } do {
+      %1 = stablehlo.while(%iterArg_0 = %iterArg) : tensor<i64>
+       cond {
+        %2 = stablehlo.compare  LT, %iterArg_0, %iterArg_0 : (tensor<i64>, tensor<i64>) -> tensor<i1>
+        stablehlo.return %2 : tensor<i1>
+      } do {
+        %2 = "stablehlo.if"(%iterArg_0) ({
+          %3 = stablehlo.while(%iterArg_1 = %iterArg_0) : tensor<i64>
+           cond {
+            %4 = stablehlo.compare  LT, %iterArg_1, %iterArg_1 : (tensor<i64>, tensor<i64>) -> tensor<i1>
+            stablehlo.return %4 : tensor<i1>
+          } do {
+            %4 = "stablehlo.gather"(%arg1, %iterArg_1) : (tensor<4x4xi64>, tensor<i64>) -> tensor<i64>
+            stablehlo.return %4 : tensor<i64>
+          }
+          stablehlo.return %3 : tensor<i64>
+        }, {
+          stablehlo.return %iterArg_0 : tensor<i64>
+        }) : (tensor<i64>) -> tensor<i64>
+        stablehlo.return %2 : tensor<i64>
+      }
+      stablehlo.return %1 : tensor<i64>
+    }
+    return %0 : tensor<i64>
+  }
+}
+"""
+
+
+def test_deeply_nested_regions():
+    m = G.parse_module(_DEEP)
+    hist = m.histogram()
+    assert hist["while"] == 3
+    assert hist["if"] == 1
+    assert hist["gather"] == 1
+    # the gather sits three while bodies down; its region path names
+    # every enclosing op, innermost last
+    paths = {op.short: path for op, path in m.ops_with_path()}
+    gp = paths["gather"]
+    assert gp.startswith("main/")
+    assert gp.count("while@") == 3 and gp.count(".do") == 3
+    assert "if@" in gp
+
+
+def test_quoted_and_escaped_attr_strings():
+    # attribute strings carrying the grammar's own delimiters — braces,
+    # parens, an escaped quote — must not unbalance region tracking
+    m = G.parse_module(
+        'module @q {\n'
+        '  func.func public @main(%arg0: tensor<4xi64>) -> tensor<4xi64> {\n'
+        '    %0 = stablehlo.custom_call @"weird\\"target{(" (%arg0)\n'
+        '      {backend_config = "a { b } ) \\" c", api_version = 2 : i32}\n'
+        '      : (tensor<4xi64>) -> tensor<4xi64>\n'
+        '    %1 = stablehlo.add %0, %arg0 : tensor<4xi64>\n'
+        '    return %1 : tensor<4xi64>\n'
+        '  }\n'
+        '}\n')
+    hist = m.histogram()
+    assert hist["custom_call"] == 1
+    assert hist["add"] == 1  # the braces inside strings didn't eat it
+    assert m.entry is not None and m.entry.name == "main"
+    assert m.custom_call_targets() == ['weird\\"target{(']
+
+
+def test_dense_literals_inside_tensor_encodings():
+    # dense<...> payloads show up both as constant initializers and
+    # inside encoding attrs; byte accounting must key off dims x dtype
+    # and ignore the rest
+    m = G.parse_module(
+        'module @d {\n'
+        '  func.func public @main(%arg0: tensor<8xi64, #stablehlo.type_extensions<bounds = [4]>>) -> tensor<2x2xi32> {\n'
+        '    %c = stablehlo.constant dense<[[1, 2], [3, 4]]> : tensor<2x2xi32>\n'
+        '    %0 = stablehlo.add %c, %c : tensor<2x2xi32>\n'
+        '    return %0 : tensor<2x2xi32>\n'
+        '  }\n'
+        '}\n')
+    assert m.histogram()["constant"] == 1
+    assert m.entry.arg_bytes() == 8 * 8  # encoding attr ignored
+    (c,) = m.find_ops("constant")
+    assert c.result_bytes() == 2 * 2 * 4
+    assert G.bytes_of_type(
+        "tensor<8xi64, #stablehlo.type_extensions<bounds = [4]>>") == 64
+
+
+def test_zero_result_ops():
+    # side-effect-only ops bind no SSA result; the parser must keep
+    # walking (and the op must still count and carry its operands)
+    m = G.parse_module(
+        'module @z {\n'
+        '  func.func public @main(%arg0: tensor<4xi64>) -> tensor<4xi64> {\n'
+        '    stablehlo.custom_call @sink(%arg0) {has_side_effect = true} : (tensor<4xi64>) -> ()\n'
+        '    "stablehlo.optimization_barrier"() : () -> ()\n'
+        '    %0 = stablehlo.add %arg0, %arg0 : tensor<4xi64>\n'
+        '    return %0 : tensor<4xi64>\n'
+        '  }\n'
+        '}\n')
+    hist = m.histogram()
+    assert hist["custom_call"] == 1
+    assert hist["optimization_barrier"] == 1
+    assert hist["add"] == 1
+    (cc,) = m.find_ops("custom_call")
+    assert cc.n_results == 0 and cc.result_bytes() == 0
+    assert cc.operands == ["%arg0"]
+    assert "sink" in m.custom_call_targets()
